@@ -1,0 +1,3 @@
+from galvatron_tpu.config.strategy import HybridParallelConfig, LayerStrategy
+
+__all__ = ["HybridParallelConfig", "LayerStrategy"]

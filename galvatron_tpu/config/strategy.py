@@ -1,0 +1,279 @@
+"""Layer-wise hybrid-parallel strategy schema.
+
+TPU-native re-design of the reference's hybrid-parallel config layer
+(reference: galvatron/core/runtime/hybrid_parallel_config.py:17-158 and
+galvatron/utils/config_utils.py:22-57). The on-disk JSON format is
+load/save-compatible with the reference (`pp_deg`, `tp_sizes_enc`,
+`tp_consecutive_flags`, `dp_types_enc`, `use_sp`, `checkpoint`, `pp_division`,
+`vtp`/`vsp`/`vcp`, `global_bsz`, `chunks`, `pipeline_type`, `default_dp_type`,
+`embed_sdp`), so searched configs are interchangeable — but the in-memory
+representation targets a `jax.sharding.Mesh`, not NCCL rank lists.
+
+Semantics (mirroring the reference):
+- ``tp``       per-layer tensor-parallel degree (Megatron-style).
+- ``sp``       per-layer flag: 1 => the tp axis is repurposed as a
+               DeepSpeed-Ulysses sequence axis (all-to-all attention) for this
+               layer (reference hybrid_parallel_config.py:261-266).
+- ``cp``       per-layer context-parallel (ring attention) degree.
+- ``fsdp``     per-layer flag: 1 => ZeRO-3 (parameter sharding) for this layer;
+               0 => ``default_dp_type`` (ddp / zero2 / zero3)
+               (reference runtime/parallel.py:61-62,107-111).
+- ``checkpoint`` per-layer activation-rematerialisation flag.
+- ``tp_consec``  rank-layout choice; on TPU this selects whether the tp role is
+               assigned to the *minor* (fast, contiguous-ICI) or *major* mesh
+               sub-axes (reference comm_groups.py:71-143; see parallel/mesh.py).
+- ``vocab_tp/vocab_sp/vocab_cp`` separate degrees for embedding/cls layers.
+- ``embed_sdp``  ZeRO-3 for embedding/cls (reference arguments.py `--embed_sdp`).
+
+The per-layer data-parallel degree is derived:
+``dp = world_size // pp // tp // cp`` (sp shares the tp sub-axes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+from galvatron_tpu.utils.jsonio import read_json_config, write_json_config
+from galvatron_tpu.utils.strategy_utils import array2str, str2array
+
+DP_TYPES = ("ddp", "zero2", "zero3")
+PIPELINE_TYPES = ("gpipe", "pipedream_flush")
+
+
+@dataclass(frozen=True)
+class LayerStrategy:
+    """Parallel strategy for a single transformer layer."""
+
+    tp: int = 1
+    cp: int = 1
+    sp: int = 0
+    fsdp: int = 0
+    checkpoint: int = 0
+    tp_consec: int = 1
+
+    def __post_init__(self):
+        if self.tp < 1 or self.cp < 1:
+            raise ValueError("tp/cp degrees must be >= 1, got tp=%d cp=%d" % (self.tp, self.cp))
+        if self.sp not in (0, 1) or self.fsdp not in (0, 1):
+            raise ValueError("sp/fsdp must be 0/1")
+
+    @property
+    def seq_shard_degree(self) -> int:
+        """How many ways the sequence dim is sharded inside this layer's
+        attention: cp always shards the sequence; ulysses-sp shards it by tp."""
+        return self.cp * (self.tp if self.sp else 1)
+
+
+def even_pp_division(total_layers: int, pp: int) -> List[int]:
+    """Default layer division across pipeline stages (reference
+    hybrid_parallel_config.py:86-89: equal with remainder on last stage)."""
+    avg = total_layers // pp
+    return [avg] * (pp - 1) + [total_layers - avg * (pp - 1)]
+
+
+def pp_stage_of_layer(pp_division: Sequence[int]) -> List[int]:
+    """`pp_ranks_enc` in the reference (hybrid_parallel_config.py:9-14)."""
+    out: List[int] = []
+    for stage, n in enumerate(pp_division):
+        out += [stage] * n
+    return out
+
+
+@dataclass
+class HybridParallelConfig:
+    """Whole-model layer-wise hybrid-parallel configuration."""
+
+    world_size: int
+    pp: int
+    layers: List[LayerStrategy]
+    global_bsz: int = 8
+    chunks: int = 1
+    pp_division: Optional[List[int]] = None
+    pipeline_type: str = "gpipe"
+    default_dp_type: str = "ddp"
+    vocab_tp: int = 1
+    vocab_sp: int = 0
+    vocab_cp: int = 1
+    embed_sdp: int = 0
+    mixed_precision: str = "bf16"
+    sequence_parallel: bool = True  # Megatron-SP activation sharding when tp>1
+
+    def __post_init__(self):
+        if self.pp_division is None:
+            self.pp_division = even_pp_division(len(self.layers), self.pp)
+        self.validate()
+
+    # ------------------------------------------------------------------ checks
+    def validate(self):
+        if self.default_dp_type not in DP_TYPES:
+            raise ValueError("default_dp_type must be one of %s" % (DP_TYPES,))
+        if self.pipeline_type not in PIPELINE_TYPES:
+            raise ValueError("pipeline_type must be one of %s" % (PIPELINE_TYPES,))
+        if self.world_size % self.pp != 0:
+            raise ValueError("world_size %d not divisible by pp %d" % (self.world_size, self.pp))
+        if len(self.pp_division) != self.pp or sum(self.pp_division) != len(self.layers):
+            raise ValueError(
+                "pp_division %s inconsistent with pp=%d, %d layers"
+                % (self.pp_division, self.pp, len(self.layers))
+            )
+        per_stage = self.world_size // self.pp
+        for i, s in enumerate(self.layers):
+            if per_stage % (s.tp * s.cp) != 0:
+                raise ValueError(
+                    "layer %d: tp*cp=%d does not divide per-stage devices %d"
+                    % (i, s.tp * s.cp, per_stage)
+                )
+        if per_stage % (self.vocab_tp * self.vocab_cp) != 0:
+            raise ValueError("vocab_tp*vocab_cp must divide per-stage devices")
+        min_tp = min([s.tp for s in self.layers] + [self.vocab_tp])
+        min_cp = min([s.cp for s in self.layers] + [self.vocab_cp])
+        min_dp = self.world_size // self.pp // min_tp // min_cp
+        if self.global_bsz % min_dp != 0:
+            # reference asserts this (hybrid_parallel_config.py:93-96)
+            raise ValueError(
+                "global_bsz %d must be a multiple of world//pp//min_tp//min_cp = %d"
+                % (self.global_bsz, min_dp)
+            )
+
+    # -------------------------------------------------------------- properties
+    @property
+    def num_layers(self) -> int:
+        return len(self.layers)
+
+    @property
+    def per_stage_devices(self) -> int:
+        return self.world_size // self.pp
+
+    def dp(self, layer_idx: int) -> int:
+        s = self.layers[layer_idx]
+        return self.per_stage_devices // (s.tp * s.cp)
+
+    @property
+    def stage_of_layer(self) -> List[int]:
+        return pp_stage_of_layer(self.pp_division)
+
+    def layers_of_stage(self, stage: int) -> List[int]:
+        lo = sum(self.pp_division[:stage])
+        return list(range(lo, lo + self.pp_division[stage]))
+
+    def dp_type(self, layer_idx: int) -> str:
+        return "zero3" if self.layers[layer_idx].fsdp else self.default_dp_type
+
+    @property
+    def microbatch_size(self) -> int:
+        if self.global_bsz % self.chunks != 0:
+            raise ValueError("global_bsz must divide evenly into chunks (pad upstream)")
+        return self.global_bsz // self.chunks
+
+    # ------------------------------------------------------------ constructors
+    @classmethod
+    def uniform(
+        cls,
+        world_size: int,
+        num_layers: int,
+        pp: int = 1,
+        tp: int = 1,
+        cp: int = 1,
+        sp: int = 0,
+        sdp: int = 0,
+        checkpoint: int = 0,
+        **kw,
+    ) -> "HybridParallelConfig":
+        """GLOBAL-mode config: one strategy for every layer (reference
+        hybrid_parallel_config.py:27-42)."""
+        layer = LayerStrategy(tp=tp, cp=cp, sp=sp, fsdp=sdp, checkpoint=checkpoint)
+        return cls(world_size=world_size, pp=pp, layers=[layer] * num_layers, **kw)
+
+    @classmethod
+    def from_json(cls, path_or_dict, world_size: int, **overrides) -> "HybridParallelConfig":
+        """Load a searched strategy JSON in the reference's on-disk format
+        (reference utils/config_utils.py:22-46)."""
+        cfg = path_or_dict if isinstance(path_or_dict, dict) else read_json_config(path_or_dict)
+        tp_sizes = str2array(cfg["tp_sizes_enc"])
+        n = len(tp_sizes)
+        cp_sizes = str2array(cfg.get("cp_sizes_enc", array2str([1] * n)))
+        consec = str2array(cfg.get("tp_consecutive_flags", array2str([1] * n)))
+        dp_types = str2array(cfg["dp_types_enc"])
+        use_sp = str2array(cfg.get("use_sp", array2str([0] * n)))
+        ckpt = str2array(cfg.get("checkpoint", array2str([0] * n)))
+        layers = [
+            LayerStrategy(
+                tp=tp_sizes[i], cp=cp_sizes[i], sp=use_sp[i], fsdp=dp_types[i],
+                checkpoint=ckpt[i], tp_consec=consec[i],
+            )
+            for i in range(n)
+        ]
+        kw = dict(
+            world_size=world_size,
+            pp=cfg["pp_deg"],
+            layers=layers,
+            global_bsz=cfg.get("global_bsz", 8),
+            chunks=cfg.get("chunks", 1),
+            pp_division=str2array(cfg["pp_division"]) if "pp_division" in cfg else None,
+            pipeline_type=cfg.get("pipeline_type", "gpipe"),
+            default_dp_type=cfg.get("default_dp_type", "ddp"),
+            vocab_tp=cfg.get("vtp", 1),
+            vocab_sp=cfg.get("vsp", 0),
+            vocab_cp=cfg.get("vcp", 1),
+            embed_sdp=cfg.get("embed_sdp", 0),
+        )
+        kw.update(overrides)
+        return cls(**kw)
+
+    # ----------------------------------------------------------- serialization
+    def to_json_dict(self) -> dict:
+        """Reference-compatible JSON dict (utils/config_utils.py:48-57 plus the
+        extra keys train_dist reads back)."""
+        return {
+            "pp_deg": self.pp,
+            "tp_sizes_enc": array2str([s.tp for s in self.layers]),
+            "tp_consecutive_flags": array2str([s.tp_consec for s in self.layers]),
+            "cp_sizes_enc": array2str([s.cp for s in self.layers]),
+            "dp_types_enc": array2str([s.fsdp for s in self.layers]),
+            "use_sp": array2str([s.sp for s in self.layers]),
+            "checkpoint": array2str([s.checkpoint for s in self.layers]),
+            "global_bsz": self.global_bsz,
+            "chunks": self.chunks,
+            "pp_division": array2str(self.pp_division),
+            "pipeline_type": self.pipeline_type,
+            "default_dp_type": self.default_dp_type,
+            "vtp": self.vocab_tp,
+            "vsp": self.vocab_sp,
+            "vcp": self.vocab_cp,
+            "embed_sdp": self.embed_sdp,
+        }
+
+    def save(self, path: str):
+        write_json_config(self.to_json_dict(), path)
+
+    # For checkpoint-resume strategy equality assertion (reference
+    # hybrid_parallel_config.py:112-124).
+    def assert_equal(self, other: "HybridParallelConfig"):
+        a, b = self.to_json_dict(), other.to_json_dict()
+        if a != b:
+            diff = {k: (a[k], b[k]) for k in a if a.get(k) != b.get(k)}
+            raise AssertionError("Hybrid parallel configs are not equal: %s" % diff)
+
+    def describe(self) -> str:
+        lines = ["pp=%d world=%d bsz=%d chunks=%d pipeline=%s default_dp=%s" % (
+            self.pp, self.world_size, self.global_bsz, self.chunks,
+            self.pipeline_type, self.default_dp_type)]
+        for i, s in enumerate(self.layers):
+            lines.append(
+                "  layer %2d: stage %d tp=%d%s cp=%d dp=%d(%s)%s%s"
+                % (
+                    i, self.stage_of_layer[i], s.tp,
+                    "(ulysses-sp)" if s.sp else "",
+                    s.cp, self.dp(i), self.dp_type(i),
+                    " ckpt" if s.checkpoint else "",
+                    "" if s.tp_consec else " nonconsec",
+                )
+            )
+        lines.append(
+            "  vocab: tp=%d sp=%d cp=%d embed_sdp=%d" % (self.vocab_tp, self.vocab_sp, self.vocab_cp, self.embed_sdp)
+        )
+        return "\n".join(lines)

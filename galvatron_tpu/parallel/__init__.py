@@ -1,0 +1,9 @@
+from galvatron_tpu.parallel.mesh import (
+    LayerAxes,
+    build_mesh,
+    layer_axes,
+    subaxis_sizes,
+    vocab_axes,
+)
+
+__all__ = ["LayerAxes", "build_mesh", "layer_axes", "vocab_axes", "subaxis_sizes"]

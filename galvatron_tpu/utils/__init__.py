@@ -1,0 +1,16 @@
+from galvatron_tpu.utils.jsonio import read_json_config, write_json_config
+from galvatron_tpu.utils.strategy_utils import (
+    array2str,
+    form_strategy,
+    print_strategies,
+    str2array,
+)
+
+__all__ = [
+    "read_json_config",
+    "write_json_config",
+    "str2array",
+    "array2str",
+    "form_strategy",
+    "print_strategies",
+]

@@ -1,0 +1,33 @@
+"""Test fixtures.
+
+Distributed-without-a-cluster mechanism (TPU-native analogue of the reference's
+subprocess+NCCL fixture, tests/conftest.py:32-71): instead of spawning worker
+processes, we run JAX on the CPU backend with 8 virtual devices
+(`--xla_force_host_platform_device_count=8`) so every sharding/collective path
+executes in-process. This must happen before jax initialises its backends."""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+# The environment may pin JAX_PLATFORMS to a TPU plugin; tests always run on
+# the virtual 8-device CPU backend (config.update wins over the env var).
+jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return devs[:8]
+
+
+@pytest.fixture(scope="session")
+def tmp_config_dir(tmp_path_factory):
+    return tmp_path_factory.mktemp("configs")

@@ -1,0 +1,99 @@
+import numpy as np
+import pytest
+
+from jax.sharding import PartitionSpec as P
+
+from galvatron_tpu.config.strategy import HybridParallelConfig, LayerStrategy
+from galvatron_tpu.parallel.mesh import (
+    build_mesh,
+    layer_axes,
+    mesh_axis_size,
+    subaxis_names,
+    subaxis_sizes,
+    vocab_axes,
+)
+from galvatron_tpu.parallel import spec as S
+
+
+def test_subaxis_sizes():
+    assert subaxis_sizes(8) == (2, 2, 2)
+    assert subaxis_sizes(1) == ()
+    assert subaxis_sizes(6) == (3, 2)
+    assert subaxis_names(4) == ("m0", "m1")
+
+
+def test_layer_axes_assignment():
+    cfg = HybridParallelConfig(
+        world_size=8, pp=1,
+        layers=[
+            LayerStrategy(tp=2),
+            LayerStrategy(tp=4, sp=1),
+            LayerStrategy(cp=2),
+            LayerStrategy(tp=2, tp_consec=0),
+            LayerStrategy(tp=2, cp=2, fsdp=1),
+        ],
+        global_bsz=8,
+    )
+    ax0 = layer_axes(cfg, 0)
+    assert ax0.tp == ("m2",) and ax0.cp == () and ax0.dp == ("m0", "m1")
+    assert ax0.megatron_sp and not ax0.ulysses
+
+    ax1 = layer_axes(cfg, 1)
+    assert ax1.tp == ("m1", "m2") and ax1.ulysses
+    assert ax1.seq_axes == ("m1", "m2")
+
+    ax2 = layer_axes(cfg, 2)
+    assert ax2.cp == ("m2",) and ax2.dp == ("m0", "m1")
+    assert ax2.seq_axes == ("m2",)
+
+    ax3 = layer_axes(cfg, 3)  # non-consecutive: tp on major axes
+    assert ax3.tp == ("m0",) and ax3.dp == ("m1", "m2")
+
+    ax4 = layer_axes(cfg, 4)
+    assert ax4.tp == ("m2",) and ax4.cp == ("m1",) and ax4.dp == ("m0",)
+    assert ax4.zero3 and ax4.zero_opt
+
+
+def test_vocab_axes():
+    cfg = HybridParallelConfig.uniform(world_size=8, num_layers=2, tp=2, global_bsz=8)
+    cfg.vocab_tp = 4
+    cfg.embed_sdp = 1
+    vax = vocab_axes(cfg)
+    assert vax.tp == ("m1", "m2") and vax.zero3
+
+
+def test_build_mesh_and_specs(devices8):
+    cfg = HybridParallelConfig.uniform(world_size=8, num_layers=2, pp=2, tp=2, global_bsz=8)
+    mesh = build_mesh(cfg, devices8)
+    assert mesh.shape == {"pp": 2, "m0": 2, "m1": 2}
+    ax = layer_axes(cfg, 0)
+    assert mesh_axis_size(mesh, ax.tp) == 2
+    assert mesh_axis_size(mesh, ax.dp) == 2
+    sp = S.act_spec(ax)
+    # batch over dp axes, seq over tp (megatron-sp active)
+    assert sp == P("m0", "m1", None)
+    assert S.col_kernel_spec(ax) == P(None, "m1")
+    assert S.row_kernel_spec(ax) == P("m1", None)
+
+
+def test_zero3_param_specs():
+    cfg = HybridParallelConfig.uniform(world_size=8, num_layers=2, tp=2, sdp=1, global_bsz=8)
+    ax = layer_axes(cfg, 0)
+    assert S.col_kernel_spec(ax) == P(("m0", "m1"), "m2")
+    assert S.row_kernel_spec(ax) == P("m2", ("m0", "m1"))
+    assert S.replicated_1d_spec(ax) == P(("m0", "m1"))
+    assert S.vocab_embed_spec(ax) == P("m2", ("m0", "m1"))
+
+
+def test_ulysses_kernels_not_tp_sharded():
+    cfg = HybridParallelConfig.uniform(world_size=8, num_layers=1, tp=4, sp=1, global_bsz=8)
+    ax = layer_axes(cfg, 0)
+    assert S.col_kernel_spec(ax) == P(None, None)
+    assert S.act_spec(ax) == P("m0", ("m1", "m2"), None)
+
+
+def test_degree_not_realisable():
+    cfg = HybridParallelConfig.uniform(world_size=6, num_layers=1, tp=1, global_bsz=6)
+    object.__setattr__(cfg.layers[0], "tp", 4) if False else None
+    with pytest.raises(ValueError):
+        HybridParallelConfig.uniform(world_size=6, num_layers=1, tp=4, global_bsz=6)

@@ -1,0 +1,77 @@
+import json
+
+import pytest
+
+from galvatron_tpu.config.strategy import (
+    HybridParallelConfig,
+    LayerStrategy,
+    even_pp_division,
+    pp_stage_of_layer,
+)
+
+
+def test_uniform_config():
+    cfg = HybridParallelConfig.uniform(world_size=8, num_layers=4, pp=2, tp=2, global_bsz=8)
+    assert cfg.per_stage_devices == 4
+    assert cfg.dp(0) == 2
+    assert cfg.pp_division == [2, 2]
+    assert cfg.stage_of_layer == [0, 0, 1, 1]
+    assert cfg.layers_of_stage(1) == [2, 3]
+
+
+def test_even_pp_division():
+    assert even_pp_division(10, 4) == [2, 2, 2, 4]
+    assert pp_stage_of_layer([1, 3]) == [0, 1, 1, 1]
+
+
+def test_validation_errors():
+    with pytest.raises(ValueError):
+        HybridParallelConfig.uniform(world_size=8, num_layers=2, pp=3)
+    with pytest.raises(ValueError):
+        HybridParallelConfig.uniform(world_size=8, num_layers=2, tp=3)
+    with pytest.raises(ValueError):
+        # global_bsz not a multiple of dp degree
+        HybridParallelConfig.uniform(world_size=8, num_layers=2, tp=1, global_bsz=3)
+
+
+def test_json_roundtrip(tmp_path):
+    layers = [
+        LayerStrategy(tp=2, fsdp=1, checkpoint=1),
+        LayerStrategy(tp=4, sp=1),
+        LayerStrategy(tp=1, cp=2),
+        LayerStrategy(tp=2, tp_consec=0),
+    ]
+    cfg = HybridParallelConfig(
+        world_size=16, pp=2, layers=layers, global_bsz=16, chunks=2,
+        pipeline_type="pipedream_flush", default_dp_type="zero2", vocab_tp=2,
+    )
+    path = str(tmp_path / "cfg.json")
+    cfg.save(path)
+    cfg2 = HybridParallelConfig.from_json(path, world_size=16)
+    cfg.assert_equal(cfg2)
+    assert cfg2.layers[1].sp == 1
+    assert cfg2.layers[3].tp_consec == 0
+    assert cfg2.dp_type(0) == "zero3"
+    assert cfg2.dp_type(2) == "zero2"
+
+
+def test_reference_format_json(tmp_path):
+    """Load a reference-style searched config (BASELINE.md example schema)."""
+    ref = {
+        "pp_deg": 1,
+        "tp_sizes_enc": "1,1,1,1",
+        "tp_consecutive_flags": "1,1,1,1",
+        "dp_types_enc": "0,0,0,0",
+        "global_bsz": 16,
+        "chunks": 1,
+        "pp_division": "4",
+        "checkpoint": "0,0,0,0",
+        "pipeline_type": "pipedream_flush",
+        "default_dp_type": "zero2",
+    }
+    p = tmp_path / "ref.json"
+    p.write_text(json.dumps(ref))
+    cfg = HybridParallelConfig.from_json(str(p), world_size=8)
+    assert cfg.pp == 1 and cfg.num_layers == 4
+    assert cfg.dp_type(0) == "zero2"
+    assert cfg.dp(0) == 8
