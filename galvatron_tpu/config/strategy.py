@@ -101,6 +101,9 @@ class HybridParallelConfig:
     embed_sdp: int = 0
     mixed_precision: str = "bf16"
     sequence_parallel: bool = True  # Megatron-SP activation sharding when tp>1
+    cp_mode: str = "zigzag"  # ring | zigzag — zigzag applies the balanced data
+    # layout as a global sequence permutation in the input pipeline
+    # (reference --cp_mode, runtime/arguments.py; redistribute.py:8-44)
 
     def __post_init__(self):
         if self.pp_division is None:
@@ -129,15 +132,20 @@ class HybridParallelConfig:
                 )
         if per_stage % (self.vocab_tp * self.vocab_cp) != 0:
             raise ValueError("vocab_tp*vocab_cp must divide per-stage devices")
-        min_tp = min([s.tp for s in self.layers] + [self.vocab_tp])
-        min_cp = min([s.cp for s in self.layers] + [self.vocab_cp])
-        min_dp = self.world_size // self.pp // min_tp // min_cp
-        if self.global_bsz % min_dp != 0:
-            # reference asserts this (hybrid_parallel_config.py:93-96)
+        # batch must divide every layer's dp degree (incl. the vocab layers):
+        # the batch dim is sharded over each layer's dp axes (cf. reference
+        # assert at hybrid_parallel_config.py:93-96, done there via min_tp)
+        max_dp = max(
+            [per_stage // (s.tp * s.cp) for s in self.layers]
+            + [per_stage // (self.vocab_tp * self.vocab_cp)]
+        )
+        if self.global_bsz % max_dp != 0:
             raise ValueError(
-                "global_bsz %d must be a multiple of world//pp//min_tp//min_cp = %d"
-                % (self.global_bsz, min_dp)
+                "global_bsz %d must be a multiple of the largest layer dp degree %d"
+                % (self.global_bsz, max_dp)
             )
+        if self.cp_mode not in ("ring", "zigzag"):
+            raise ValueError("cp_mode must be 'ring' or 'zigzag', got %r" % (self.cp_mode,))
 
     # -------------------------------------------------------------- properties
     @property
@@ -162,6 +170,10 @@ class HybridParallelConfig:
 
     def dp_type(self, layer_idx: int) -> str:
         return "zero3" if self.layers[layer_idx].fsdp else self.default_dp_type
+
+    @property
+    def max_cp(self) -> int:
+        return max([s.cp for s in self.layers] + [self.vocab_cp])
 
     @property
     def microbatch_size(self) -> int:
@@ -220,6 +232,7 @@ class HybridParallelConfig:
             vocab_sp=cfg.get("vsp", 0),
             vocab_cp=cfg.get("vcp", 1),
             embed_sdp=cfg.get("embed_sdp", 0),
+            cp_mode=cfg.get("cp_mode", "zigzag"),
         )
         kw.update(overrides)
         return cls(**kw)
@@ -245,6 +258,7 @@ class HybridParallelConfig:
             "vsp": self.vocab_sp,
             "vcp": self.vocab_cp,
             "embed_sdp": self.embed_sdp,
+            "cp_mode": self.cp_mode,
         }
 
     def save(self, path: str):

@@ -1,0 +1,5 @@
+from galvatron_tpu.ops.norms import layer_norm, rms_norm
+from galvatron_tpu.ops.rope import apply_rotary, rope_frequencies
+from galvatron_tpu.ops.attention import core_attention
+
+__all__ = ["layer_norm", "rms_norm", "apply_rotary", "rope_frequencies", "core_attention"]

@@ -1,0 +1,94 @@
+"""Core attention with XLA / Pallas-flash dispatch.
+
+TPU-native counterpart of the reference's CoreAttention /
+FlashSelfOrCrossAttention dispatch (galvatron/core/runtime/tensor_parallel/
+transformer.py:306,432,860-892). The parallel forms differ structurally:
+
+- Megatron-TP / Megatron-SP / Ulysses all reduce to *local* attention on
+  (B, S, nh/shard, hd) activations — GSPMD materialises the surrounding
+  all-gather (SP) or all-to-all (Ulysses, reference transformer.py:1928-2177)
+  when resharding from seq-sharded to head-sharded, so one code path serves
+  all three.
+- Ring/zigzag context parallelism keeps blockwise softmax state across
+  `ppermute` steps and lives in ops/ring_attention.py.
+
+Layouts here are (batch, seq, heads, head_dim) ("BSNH"); the pallas kernel
+path transposes to its (batch, heads, seq, head_dim) convention.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def repeat_kv(k: jax.Array, n_rep: int) -> jax.Array:
+    """GQA: expand (B, S, n_kv, hd) to (B, S, n_kv*n_rep, hd)
+    (reference ParallelAttention GQA, transformer.py:576-583)."""
+    if n_rep == 1:
+        return k
+    b, s, nkv, hd = k.shape
+    return jnp.broadcast_to(k[:, :, :, None, :], (b, s, nkv, n_rep, hd)).reshape(b, s, nkv * n_rep, hd)
+
+
+def _xla_attention(q, k, v, *, causal: bool, sm_scale: float, bias=None, q_offset=0):
+    """Einsum attention with fp32 softmax; XLA fuses mask+softmax into the MXU
+    matmuls. `q_offset` shifts the causal mask for cross-shard blocks."""
+    b, sq, nh, hd = q.shape
+    sk = k.shape[1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    logits = logits * sm_scale
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
+    if causal:
+        q_pos = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0) + q_offset
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        logits = jnp.where(q_pos >= k_pos, logits, DEFAULT_MASK_VALUE)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _pallas_flash(q, k, v, *, causal: bool, sm_scale: float):
+    from jax.experimental.pallas.ops.tpu.flash_attention import flash_attention
+
+    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    out = flash_attention(qt, kt, vt, causal=causal, sm_scale=sm_scale)
+    return out.transpose(0, 2, 1, 3)
+
+
+def core_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    bias: Optional[jax.Array] = None,
+    impl: str = "auto",
+) -> jax.Array:
+    """Multi-head attention on (B, S, nh, hd) tensors (kv may have fewer heads:
+    GQA is expanded here)."""
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    if k.shape[2] != q.shape[2]:
+        assert q.shape[2] % k.shape[2] == 0, "q heads must be a multiple of kv heads"
+        n_rep = q.shape[2] // k.shape[2]
+        k = repeat_kv(k, n_rep)
+        v = repeat_kv(v, n_rep)
+    if impl == "auto":
+        on_tpu = jax.default_backend() not in ("cpu",)
+        # pallas flash path needs seq/head tiling-friendly shapes
+        ok_shapes = (
+            q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0 and q.shape[3] >= 128 and bias is None
+        )
+        impl = "flash" if (on_tpu and ok_shapes) else "xla"
+    if impl == "flash":
+        return _pallas_flash(q, k, v, causal=causal, sm_scale=sm_scale)
+    if impl == "xla":
+        return _xla_attention(q, k, v, causal=causal, sm_scale=sm_scale, bias=bias)
+    raise ValueError("unknown attention impl %r" % impl)

@@ -1,0 +1,26 @@
+"""Normalisation ops with fp32 accumulation.
+
+The reference relies on Megatron fused LayerNorm CUDA kernels
+(site_package/megatron legacy fused kernels); on TPU, XLA fuses these
+elementwise chains into the surrounding matmuls, so plain jnp with explicit
+fp32 accumulation is the idiomatic (and fast) form."""
+
+import jax.numpy as jnp
+
+
+def layer_norm(x, scale, bias, eps: float = 1e-5):
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+    y = (x32 - mean) * jnp.reciprocal(jnp.sqrt(var + eps))
+    y = y * scale.astype(jnp.float32) + bias.astype(jnp.float32)
+    return y.astype(dtype)
+
+
+def rms_norm(x, scale, eps: float = 1e-6):
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    y = x32 * jnp.reciprocal(jnp.sqrt(var + eps)) * scale.astype(jnp.float32)
+    return y.astype(dtype)

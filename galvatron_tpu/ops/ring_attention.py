@@ -1,0 +1,134 @@
+"""Ring attention (context parallelism) via shard_map + ppermute.
+
+TPU-native re-design of the reference's ring/zigzag flash attention
+(galvatron/core/runtime/tensor_parallel/transformer.py:2252-2670, adapted
+there from zhuzilin/ring-flash-attention): K/V blocks rotate around the cp
+ring with `lax.ppermute` while an online-softmax accumulator folds in each
+block's contribution. The python ring loop unrolls under jit so XLA can
+overlap each step's ppermute with the previous step's block compute.
+
+Two departures from the reference:
+
+1. **Position-driven masking.** The causal mask is computed from the *global
+   position arrays* carried with the activations (`q_pos >= k_pos`), not from
+   block indices. Any sequence layout — contiguous blocks or zigzag — is
+   therefore correct automatically.
+2. **Zigzag as data layout.** The reference transforms activations
+   linear<->zigzag between layers (redistribute.py:8-44). Here, a transformer
+   is permutation-equivariant given per-token positions, so the zigzag
+   balance trick is applied ONCE as a global sequence permutation in the
+   input pipeline (`zigzag_permutation`), and every layer — cp or not — sees
+   the same layout. No runtime layout transforms at strategy boundaries.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from galvatron_tpu.ops.attention import DEFAULT_MASK_VALUE, repeat_kv
+from galvatron_tpu.parallel.mesh import LayerAxes, mesh_axis_size
+
+NEG_INF = DEFAULT_MASK_VALUE
+
+
+def zigzag_permutation(seq_len: int, cp: int) -> np.ndarray:
+    """Global seq permutation placing chunks (i, 2cp-1-i) on shard i
+    (reference redistribute.py:8-27). Returns idx s.t. x_zigzag = x[idx]."""
+    assert seq_len % (2 * cp) == 0, "seq_len must divide 2*cp"
+    chunk = seq_len // (2 * cp)
+    order = []
+    for r in range(cp):
+        order += [r, 2 * cp - 1 - r]
+    idx = np.concatenate([np.arange(c * chunk, (c + 1) * chunk) for c in order])
+    return idx
+
+
+def inverse_permutation(idx: np.ndarray) -> np.ndarray:
+    inv = np.empty_like(idx)
+    inv[idx] = np.arange(len(idx))
+    return inv
+
+
+def _ring_body(q, k, v, q_pos, k_pos, *, cp_axes: Tuple[str, ...], cp_size: int,
+               causal: bool, sm_scale: float):
+    """Per-shard ring attention. q: (b, sq, nh, hd); k/v: (b, sk, nh, hd);
+    q_pos/k_pos: (b, sq)/(b, sk) global positions."""
+    b, sq, nh, hd = q.shape
+    acc = jnp.zeros((b, nh, sq, hd), jnp.float32)
+    row_max = jnp.full((b, nh, sq), -jnp.inf, jnp.float32)
+    row_sum = jnp.zeros((b, nh, sq), jnp.float32)
+    n = cp_size
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    k_cur, v_cur, kpos_cur = k, v, k_pos
+    for step in range(n):
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_cur, preferred_element_type=jnp.float32)
+        logits = logits * sm_scale
+        if causal:
+            mask = q_pos[:, None, :, None] >= kpos_cur[:, None, None, :]
+            logits = jnp.where(mask, logits, NEG_INF)
+        blk_max = jnp.max(logits, axis=-1)
+        new_max = jnp.maximum(row_max, blk_max)
+        # guard -inf rows (fully masked block)
+        safe_max = jnp.where(jnp.isfinite(new_max), new_max, 0.0)
+        corr = jnp.exp(jnp.where(jnp.isfinite(row_max), row_max - safe_max, -jnp.inf))
+        corr = jnp.where(jnp.isfinite(row_max), corr, 0.0)
+        probs = jnp.exp(logits - safe_max[..., None])
+        if causal:
+            probs = jnp.where(mask, probs, 0.0)
+        row_sum = row_sum * corr + jnp.sum(probs, axis=-1)
+        acc = acc * corr[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", probs.astype(v_cur.dtype), v_cur,
+            preferred_element_type=jnp.float32,
+        )
+        row_max = new_max
+        if step < n - 1:
+            k_cur = jax.lax.ppermute(k_cur, cp_axes, perm)
+            v_cur = jax.lax.ppermute(v_cur, cp_axes, perm)
+            kpos_cur = jax.lax.ppermute(kpos_cur, cp_axes, perm)
+    out = acc / jnp.maximum(row_sum, 1e-37)[..., None]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    positions: jax.Array,
+    *,
+    mesh: Mesh,
+    axes: LayerAxes,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+) -> jax.Array:
+    """Ring attention over `axes.cp`. Inputs are GLOBAL arrays:
+    q/k/v (B, S, nh, hd) sharded (dp, cp, tp, -), positions (B, S) (dp, cp)."""
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    if k.shape[2] != q.shape[2]:
+        n_rep = q.shape[2] // k.shape[2]
+        k = repeat_kv(k, n_rep)
+        v = repeat_kv(v, n_rep)
+
+    from galvatron_tpu.parallel.spec import _ax
+
+    bd, cp, tp = _ax(axes.batch_axes), _ax(axes.cp), _ax(axes.tp)
+    qkv_spec = P(bd, cp, tp, None)
+    pos_spec = P(bd, cp)
+    cp_size = mesh_axis_size(mesh, axes.cp)
+    body = lambda q_, k_, v_, qp_, kp_: _ring_body(
+        q_, k_, v_, qp_, kp_, cp_axes=tuple(axes.cp), cp_size=cp_size,
+        causal=causal, sm_scale=sm_scale,
+    )
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, pos_spec, pos_spec),
+        out_specs=qkv_spec,
+    )(q, k, v, positions, positions)

@@ -1,0 +1,199 @@
+"""SPMD pipeline parallelism: scan over microbatch ticks + ppermute stage shift.
+
+TPU-native replacement for the reference's hand-rolled pipeline engine
+(galvatron/core/runtime/pipeline/pipeline.py: GPipe :718-883, 1F1B :375-701,
+batched P2P :1080-1257). Instead of per-rank send/recv of activations, the
+whole pipeline is ONE jitted SPMD program:
+
+- layer parameters are *stacked across stages* with a leading ``pp`` dim
+  sharded over the ``pp`` mesh axis, so stage s's weights live only on its
+  devices;
+- activations live in a ``(pp, mb, S, H)`` rolling buffer, also ``pp``-sharded;
+- each scan tick vmaps the stage body over the pp dim (GSPMD partitions it so
+  every stage group computes only its own slice — MPMD from vmap+sharding),
+  then ``jnp.roll`` shifts outputs to the next stage: XLA lowers the roll of a
+  pp-sharded buffer to a single collective-permute over ICI, the analogue of
+  the reference's `batch_isend_irecv` p2p (pipeline.py:1095-1127);
+- microbatch t enters stage 0 at tick t and exits stage pp-1 at tick t+pp-1;
+  total ticks = num_microbatches + pp - 1 (the GPipe bubble).
+
+The backward pass is jax autodiff through the scan — including the reversed
+collective-permutes — which also makes tied-embedding gradients (used by both
+stage 0 and the last stage) correct with no embedding-group all-reduce
+(reference grad_reduce.py:68-124).
+
+`pipeline_type="pipedream_flush"` is accepted for config compatibility; both
+schedules execute this scan pipeline (same bubble fraction (pp-1)/m as 1F1B;
+1F1B's lower activation watermark is covered by per-stage rematerialisation).
+
+Current restrictions (asserted): equal layers per stage; within-stage layer
+strategies uniform across stages; no ring-attention CP inside pp>1 (cp
+composes with tp/sp/dp; cp+pp lands with the pallas ring kernel).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from galvatron_tpu.config.strategy import HybridParallelConfig
+from galvatron_tpu.parallel import spec as S
+from galvatron_tpu.parallel.mesh import PP_AXIS, layer_axes, vocab_axes
+
+Params = Dict[str, Any]
+
+
+def validate_pipeline_config(hp: HybridParallelConfig):
+    if hp.pp <= 1:
+        return
+    div = hp.pp_division
+    if len(set(div)) != 1:
+        raise ValueError(
+            "pipelined execution requires equal layers per stage, got pp_division=%s "
+            "(pad the model or use pp_division of equal parts)" % (div,)
+        )
+    lps = div[0]
+    for j in range(lps):
+        strategies = {hp.layers[s * lps + j] for s in range(hp.pp)}
+        if len(strategies) != 1:
+            raise ValueError(
+                "within-stage layer %d must use the same strategy on every stage "
+                "for the stacked pipeline; got %s" % (j, strategies)
+            )
+    for s in hp.layers:
+        if s.cp > 1:
+            raise ValueError("cp>1 with pp>1 is not yet supported in the scan pipeline")
+    if hp.global_bsz % hp.chunks != 0:
+        raise ValueError("global_bsz must divide into chunks")
+
+
+def layers_per_stage(hp: HybridParallelConfig) -> int:
+    return hp.pp_division[0]
+
+
+# ------------------------------------------------------- stacked param layout
+def stack_layer_specs(cfg, hp: HybridParallelConfig):
+    """Param specs for the stacked layout: for each within-stage layer index j,
+    the per-layer spec prefixed with the pp axis."""
+    from galvatron_tpu.models.base import layer_param_specs
+
+    lps = layers_per_stage(hp)
+    out = []
+    for j in range(lps):
+        ax = layer_axes(hp, j)  # uniform across stages (validated)
+        spec_j = layer_param_specs(cfg, ax)
+        out.append(jax.tree.map(lambda sp: P(PP_AXIS, *sp), spec_j, is_leaf=lambda x: isinstance(x, P)))
+    return out
+
+
+def stack_params(layer_params: List[Params], hp: HybridParallelConfig) -> List[Params]:
+    """[n_layers trees] -> [layers_per_stage trees with leading pp dim]."""
+    lps = layers_per_stage(hp)
+    stacked = []
+    for j in range(lps):
+        per_stage = [layer_params[s * lps + j] for s in range(hp.pp)]
+        stacked.append(jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage))
+    return stacked
+
+
+def unstack_params(stacked: List[Params], hp: HybridParallelConfig) -> List[Params]:
+    lps = layers_per_stage(hp)
+    layers: List[Params] = [None] * (lps * hp.pp)  # type: ignore
+    for j, tree in enumerate(stacked):
+        for s in range(hp.pp):
+            layers[s * lps + j] = jax.tree.map(lambda x: x[s], tree)
+    return layers
+
+
+# ----------------------------------------------------------------- the engine
+def pipeline_apply(
+    stacked_layers: List[Params],
+    x_mb: jax.Array,  # (num_mb, mb, S, H) embedded microbatches
+    positions_mb: jax.Array,  # (num_mb, mb, S)
+    cfg,
+    hp: HybridParallelConfig,
+    mesh: Mesh,
+) -> jax.Array:
+    """Run the scan pipeline; returns (num_mb, mb, S, H) last-stage outputs."""
+    from galvatron_tpu.models.base import layer_forward
+
+    pp, num_mb = hp.pp, hp.chunks
+    lps = layers_per_stage(hp)
+
+    def stage_body(stage_layers: List[Params], x, pos):
+        for j in range(lps):
+            fwd = partial(layer_forward, cfg=cfg, mesh=None, axes=None)
+            if hp.layers[j].checkpoint:
+                fwd = jax.checkpoint(fwd)
+            x = fwd(stage_layers[j], x, pos)
+        return x
+
+    vstage = jax.vmap(stage_body, in_axes=(0, 0, 0))
+
+    ax0 = layer_axes(hp, 0)
+    buf_spec = P(PP_AXIS, S._ax(ax0.batch_axes), S._ax(ax0.seq_axes), None)
+    pos_buf_spec = P(PP_AXIS, S._ax(ax0.batch_axes), S._ax(ax0.seq_axes))
+
+    mb_shape = x_mb.shape[1:]
+    state = jnp.zeros((pp,) + mb_shape, x_mb.dtype)
+    state_pos = jnp.zeros((pp,) + positions_mb.shape[1:], positions_mb.dtype)
+
+    total = num_mb + pp - 1
+    pad = total - num_mb
+    xs_x = jnp.concatenate([x_mb, jnp.zeros((pad,) + mb_shape, x_mb.dtype)], 0)
+    xs_p = jnp.concatenate(
+        [positions_mb, jnp.zeros((pad,) + positions_mb.shape[1:], positions_mb.dtype)], 0
+    )
+
+    def tick(carry, xt):
+        state, state_pos = carry
+        inp, inp_pos = xt
+        # shift previous outputs to the next stage; microbatch enters stage 0.
+        state = jnp.roll(state, 1, axis=0).at[0].set(inp)
+        state_pos = jnp.roll(state_pos, 1, axis=0).at[0].set(inp_pos)
+        state = S.constrain(state, mesh, buf_spec)
+        state_pos = S.constrain(state_pos, mesh, pos_buf_spec)
+        out = vstage(stacked_layers, state, state_pos)
+        out = S.constrain(out, mesh, buf_spec)
+        return (out, state_pos), out[-1]
+
+    (_, _), ys = jax.lax.scan(tick, (state, state_pos), (xs_x, xs_p))
+    return ys[pp - 1 :]
+
+
+def make_pipelined_loss(cfg, hp: HybridParallelConfig, mesh: Mesh):
+    """Loss over the pipelined model; batch is split into `chunks` microbatches
+    INSIDE this function, so the train step's grad-accumulation loop must not
+    split again (model_api handles this)."""
+    from galvatron_tpu.models import base as M
+
+    validate_pipeline_config(hp)
+    vax = vocab_axes(hp)
+
+    def loss_fn(params, batch):
+        tokens, positions, labels = batch["tokens"], batch["positions"], batch["labels"]
+        num_mb = hp.chunks
+        B = tokens.shape[0]
+        mb = B // num_mb
+
+        def split(x):
+            return x.reshape((num_mb, mb) + x.shape[1:])
+
+        pos_mb = split(positions)
+        # embed all microbatches up-front (replicated across pp groups; the
+        # vocab layers' own parallelism comes from vocab_tp/vocab_sp axes)
+        x = M.embed_tokens(params["embed"], tokens, positions, cfg, mesh, vax)
+        x = split(x)
+        outs = pipeline_apply(params["stages"], x, pos_mb, cfg, hp, mesh)
+        h = outs.reshape((B,) + tokens.shape[1:] + (cfg.hidden_size,))
+        h = S.constrain(h, mesh, S.act_spec(vax))
+        logits = M.lm_logits(params, h, cfg)
+        logits = S.constrain(logits, mesh, S.logits_spec(vax))
+        loss_mask = batch.get("loss_mask")
+        return M.vocab_parallel_cross_entropy(logits, labels, loss_mask)
+
+    return loss_fn

@@ -59,8 +59,14 @@ def act_spec(ax: LayerAxes, *, seq_dim: int = 1, ndim: int = 3) -> P:
 
 
 def logits_spec(ax: LayerAxes) -> P:
-    """(batch, seq, vocab) with vocab sharded over tp (vocab-parallel lm head)."""
-    return P(_ax(ax.batch_axes), _ax(ax.seq_axes), _ax(ax.tp))
+    """(batch, seq, vocab) logits. vocab_sp=0: vocab sharded over tp
+    (vocab-parallel lm head + loss). vocab_sp=1 (ulysses/vocab-SP): sequence
+    stays tp-sharded and vocab is dense (reference
+    vocab_sequence_parallel_cross_entropy, site_package/megatron/core/
+    tensor_parallel/cross_entropy.py:174-219)."""
+    if ax.ulysses:
+        return P(_ax(ax.batch_axes), _ax(ax.seq_axes), None)
+    return P(_ax(ax.batch_axes), _ax(ax.cp), _ax(ax.tp))
 
 
 # ------------------------------------------------------------------ parameters
@@ -95,7 +101,11 @@ def replicated_1d_spec(ax: LayerAxes) -> P:
 
 def vocab_embed_spec(ax: LayerAxes) -> P:
     """(vocab, hidden) embedding table, vocab-parallel over tp
-    (reference: VocabParallelEmbedding, models/gpt_hf/GPTModel_tensor_parallel.py:84-132)."""
+    (reference: VocabParallelEmbedding, models/gpt_hf/GPTModel_tensor_parallel.py:84-132).
+    Under vocab-SP (ulysses) the tp axes carry sequence, so the table stays
+    vocab-dense (matching logits_spec) and ZeRO-3 shards the vocab dim."""
+    if ax.ulysses:
+        return P(_ax(_zero3_axes(ax) or ()), None)
     return P(_ax(ax.tp), _ax(_zero3_axes(ax) or ()))
 
 

@@ -1,0 +1,8 @@
+from galvatron_tpu.runtime.model_api import HybridParallelModel, construct_hybrid_parallel_model
+from galvatron_tpu.runtime.optimizer import get_optimizer_and_scheduler
+
+__all__ = [
+    "HybridParallelModel",
+    "construct_hybrid_parallel_model",
+    "get_optimizer_and_scheduler",
+]

@@ -1,0 +1,80 @@
+"""Input pipeline: batch preparation, zigzag CP layout, synthetic data.
+
+Counterpart of the reference's dataloader glue + per-model `get_batch`
+(galvatron/core/runtime/dataloader.py:4-20, models/gpt_hf/dataloader.py:137,
+random-data fallback in the same file). The Megatron-style indexed dataset
+(C++ sample-index builder, site_package/megatron/core/datasets/helpers.cpp)
+lands in galvatron_tpu/data/.
+
+`prepare_batch` is where the zigzag context-parallel layout is applied: the
+model is permutation-equivariant given per-token positions (see
+ops/ring_attention.py), so the reference's runtime linear<->zigzag activation
+transforms (redistribute.py:8-44) reduce to permuting tokens/labels/positions
+once per batch here, when `hp.cp_mode == "zigzag"` and any layer has cp>1."""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from galvatron_tpu.config.strategy import HybridParallelConfig
+from galvatron_tpu.ops.ring_attention import zigzag_permutation
+
+
+def prepare_batch(
+    hp: Optional[HybridParallelConfig],
+    tokens: np.ndarray,
+    labels: Optional[np.ndarray] = None,
+    loss_mask: Optional[np.ndarray] = None,
+) -> Dict[str, jnp.ndarray]:
+    """tokens (B, S) -> model batch dict with positions/labels, zigzag-permuted
+    along the sequence when the strategy uses zigzag context parallelism."""
+    tokens = np.asarray(tokens)
+    B, S = tokens.shape
+    if labels is None:
+        labels = np.roll(tokens, -1, axis=1)
+        if loss_mask is None:
+            loss_mask = np.ones((B, S), np.float32)
+            loss_mask[:, -1] = 0.0  # rolled last token has no target
+    positions = np.broadcast_to(np.arange(S), (B, S))
+    batch = {
+        "tokens": tokens,
+        "positions": positions,
+        "labels": labels,
+    }
+    if loss_mask is not None:
+        batch["loss_mask"] = loss_mask
+    if hp is not None and hp.cp_mode == "zigzag" and hp.max_cp > 1:
+        idx = zigzag_permutation(S, hp.max_cp)
+        batch = {k: v[:, idx] for k, v in batch.items()}
+    return {k: jnp.asarray(v) for k, v in batch.items()}
+
+
+class RandomTextDataset:
+    """Deterministic synthetic token stream (the reference models' random-data
+    fallback path, models/gpt_hf/dataloader.py)."""
+
+    def __init__(self, vocab_size: int, seq_len: int, size: int = 1024, seed: int = 1234):
+        self.vocab_size = vocab_size
+        self.seq_len = seq_len
+        self.size = size
+        self.seed = seed
+
+    def batch(self, step: int, batch_size: int) -> np.ndarray:
+        rng = np.random.RandomState(self.seed + step % max(self.size, 1))
+        return rng.randint(0, self.vocab_size, (batch_size, self.seq_len))
+
+    def iterator(self, hp: HybridParallelConfig) -> Iterator[Dict[str, jnp.ndarray]]:
+        step = 0
+        while True:
+            yield prepare_batch(hp, self.batch(step, hp.global_bsz))
+            step += 1
+
+
+def get_train_iterator(
+    hp: HybridParallelConfig, vocab_size: int, seq_len: int, seed: int = 1234
+) -> Iterator[Dict[str, jnp.ndarray]]:
+    return RandomTextDataset(vocab_size, seq_len, seed=seed).iterator(hp)

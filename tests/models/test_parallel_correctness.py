@@ -1,0 +1,121 @@
+"""Parallel-strategy correctness: every strategy must reproduce the
+single-device baseline loss and training trajectory (the reference's
+train-few-steps-and-compare pattern, tests/models/test_model_correctness.py:17-50,
+re-done without subprocesses on the virtual CPU mesh)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from galvatron_tpu.config.strategy import HybridParallelConfig, LayerStrategy
+from galvatron_tpu.models import base as M
+from galvatron_tpu.runtime.model_api import construct_hybrid_parallel_model
+from galvatron_tpu.runtime.optimizer import OptimizerArgs, get_optimizer_and_scheduler
+
+B, S, V = 8, 32, 128
+
+pytestmark = [pytest.mark.parallel, pytest.mark.distributed]
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return M.TransformerConfig(
+        hidden_size=64, num_heads=4, num_layers=4, vocab_size=V, max_seq_len=64,
+        compute_dtype=jnp.float32,
+    )
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return M.init_model_params(jax.random.PRNGKey(0), cfg)
+
+
+def make_batch(seed):
+    tokens = jax.random.randint(jax.random.PRNGKey(seed), (B, S), 0, V)
+    return dict(
+        tokens=tokens,
+        positions=jnp.broadcast_to(jnp.arange(S), (B, S)),
+        labels=jnp.roll(tokens, -1, 1),
+    )
+
+
+STRATEGIES = {
+    "dp8": dict(tp=1),
+    "tp2_megatron_sp": dict(tp=2),
+    "tp4_ulysses": dict(tp=4, sp=1),
+    "cp2_ring": dict(cp=2),
+    "zero3": dict(sdp=1),
+    "tp2_nonconsec": dict(tp=2),
+}
+
+
+@pytest.mark.parametrize("name", list(STRATEGIES))
+def test_loss_matches_baseline(name, cfg, params, devices8):
+    kw = dict(STRATEGIES[name])
+    hp = HybridParallelConfig.uniform(8, cfg.num_layers, global_bsz=B, **kw)
+    if name == "tp2_nonconsec":
+        hp.layers = [LayerStrategy(tp=2, tp_consec=0)] * cfg.num_layers
+    batch = make_batch(0)
+    baseline = float(M.lm_loss_fn(params, batch, cfg))
+    m = construct_hybrid_parallel_model(cfg, hp, devices8)
+    p_sh = jax.device_put(params, m.shardings())
+    loss = float(jax.jit(m.loss_fn)(p_sh, m.shard_batch(batch)))
+    assert abs(loss - baseline) < 2e-5, (name, loss, baseline)
+
+
+def _train_losses(cfg, params, hp, devices, steps=4):
+    m = construct_hybrid_parallel_model(cfg, hp, devices)
+    # copy: the train step donates its params argument; device_put may alias
+    p = jax.device_put(jax.tree.map(jnp.copy, params), m.shardings())
+    tx, _ = get_optimizer_and_scheduler(OptimizerArgs(lr=1e-3, warmup_steps=2, total_steps=10, weight_decay=0.0))
+    opt_state = m.init_opt_state(tx, p)
+    step = m.make_train_step(tx)
+    out = []
+    for i in range(steps):
+        p, opt_state, metrics = step(p, opt_state, m.shard_batch(make_batch(i % 2)))
+        out.append(float(metrics["loss"]))
+    return out
+
+
+def test_training_trajectory_strategy_invariant(cfg, params, devices8):
+    ref = _train_losses(cfg, params, HybridParallelConfig.uniform(8, 4, global_bsz=B), devices8)
+    assert ref[-1] < ref[0], "training should reduce loss"
+    hetero = HybridParallelConfig(
+        world_size=8, pp=1,
+        layers=[
+            LayerStrategy(tp=2),
+            LayerStrategy(tp=4, sp=1),
+            LayerStrategy(cp=2, fsdp=1),
+            LayerStrategy(checkpoint=1),
+        ],
+        global_bsz=B, chunks=2, default_dp_type="zero2",
+    )
+    got = _train_losses(cfg, params, hetero, devices8)
+    assert max(abs(a - b) for a, b in zip(ref, got)) < 5e-5, (ref, got)
+
+
+def test_grad_accumulation_matches_single_chunk(cfg, params, devices8):
+    one = _train_losses(cfg, params, HybridParallelConfig.uniform(8, 4, global_bsz=B, chunks=1), devices8)
+    two = _train_losses(cfg, params, HybridParallelConfig.uniform(8, 4, global_bsz=B, chunks=2), devices8)
+    assert max(abs(a - b) for a, b in zip(one, two)) < 5e-5
+
+
+def test_zero2_opt_state_is_sharded(cfg, params, devices8):
+    hp = HybridParallelConfig.uniform(8, 4, global_bsz=B, default_dp_type="zero2")
+    m = construct_hybrid_parallel_model(cfg, hp, devices8)
+    p = jax.device_put(params, m.shardings())
+    tx, _ = get_optimizer_and_scheduler(OptimizerArgs())
+    opt_state = m.init_opt_state(tx, p)
+    # adam moments for a replicated (ddp-would-be) kernel must be dp-sharded
+    leaves_with_path = jax.tree_util.tree_leaves_with_path(opt_state)
+    import numpy as np
+
+    mu_kernel = [
+        l for pth, l in leaves_with_path
+        if "mu" in str(pth) and "wqkv" in str(pth) and "kernel" in str(pth)
+    ]
+    assert mu_kernel, "expected adam mu for wqkv kernel"
+    shard_counts = {len(set(l.sharding.device_set)) for l in mu_kernel}
+    assert shard_counts == {8}
+    nbytes_local = mu_kernel[0].addressable_shards[0].data.nbytes
+    assert nbytes_local * 8 == mu_kernel[0].nbytes  # fully partitioned

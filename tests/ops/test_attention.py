@@ -1,0 +1,108 @@
+"""Attention op correctness: ring attention vs dense reference, zigzag layout,
+GQA, rope."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from galvatron_tpu.ops.attention import core_attention, repeat_kv
+from galvatron_tpu.ops.ring_attention import (
+    inverse_permutation,
+    ring_attention,
+    zigzag_permutation,
+)
+from galvatron_tpu.ops.rope import apply_rotary
+from galvatron_tpu.parallel.mesh import LayerAxes
+
+pytestmark = [pytest.mark.parallel]
+
+
+def _rand_qkv(rng, b=2, s=32, nh=4, nkv=None, hd=16):
+    kq, kk, kv = jax.random.split(rng, 3)
+    q = jax.random.normal(kq, (b, s, nh, hd), jnp.float32)
+    k = jax.random.normal(kk, (b, s, nkv or nh, hd), jnp.float32)
+    v = jax.random.normal(kv, (b, s, nkv or nh, hd), jnp.float32)
+    return q, k, v
+
+
+def test_xla_attention_causal_matches_manual():
+    q, k, v = _rand_qkv(jax.random.PRNGKey(0))
+    out = core_attention(q, k, v, causal=True, impl="xla")
+    s = q.shape[1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(q.shape[-1])
+    mask = np.tril(np.ones((s, s), bool))
+    logits = jnp.where(mask[None, None], logits, -1e30)
+    ref = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(logits, -1), v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_gqa_repeat():
+    q, k, v = _rand_qkv(jax.random.PRNGKey(1), nh=8, nkv=2)
+    out = core_attention(q, k, v, causal=True, impl="xla")
+    out2 = core_attention(q, repeat_kv(k, 4), repeat_kv(v, 4), causal=True, impl="xla")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out2), atol=1e-6)
+
+
+@pytest.mark.parametrize("zigzag", [False, True])
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_matches_dense(devices8, zigzag, causal):
+    b, s, nh, hd = 2, 32, 4, 16
+    cp = 4
+    q, k, v = _rand_qkv(jax.random.PRNGKey(2), b=b, s=s, nh=nh, hd=hd)
+    positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+    dense = core_attention(q, k, v, causal=causal, impl="xla")
+
+    if zigzag:
+        idx = zigzag_permutation(s, cp)
+        qp, kp, vp = q[:, idx], k[:, idx], v[:, idx]
+        pos_p = positions[:, idx]
+    else:
+        qp, kp, vp, pos_p = q, k, v, positions
+
+    mesh = Mesh(np.array(devices8).reshape(2, 4), ("m0", "m1"))
+    axes = LayerAxes(dp=("m0",), cp=("m1",), tp=())
+    sharded = lambda t, spec: jax.device_put(t, NamedSharding(mesh, spec))
+    out = ring_attention(
+        sharded(qp, P("m0", "m1", None, None)),
+        sharded(kp, P("m0", "m1", None, None)),
+        sharded(vp, P("m0", "m1", None, None)),
+        sharded(pos_p, P("m0", "m1")),
+        mesh=mesh, axes=axes, causal=causal,
+    )
+    out = np.asarray(out)
+    if zigzag:
+        inv = inverse_permutation(zigzag_permutation(s, cp))
+        out = out[:, inv]
+    np.testing.assert_allclose(out, np.asarray(dense), atol=3e-5)
+
+
+def test_zigzag_permutation_roundtrip():
+    idx = zigzag_permutation(32, 4)
+    inv = inverse_permutation(idx)
+    x = np.arange(32)
+    assert (x[idx][inv] == x).all()
+    # shard 0 holds chunks 0 and 7 (balanced causal load)
+    chunk = 32 // 8
+    shard0 = idx[: 2 * chunk]
+    assert set(shard0) == set(range(0, chunk)) | set(range(7 * chunk, 32))
+
+
+def test_rope_rotation_invariants():
+    b, s, nh, hd = 1, 8, 2, 16
+    x = jax.random.normal(jax.random.PRNGKey(3), (b, s, nh, hd))
+    pos = jnp.broadcast_to(jnp.arange(s), (b, s))
+    out = apply_rotary(x, pos)
+    # norms preserved
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(out), axis=-1), np.linalg.norm(np.asarray(x), axis=-1), rtol=1e-5
+    )
+    # position 0 is identity
+    np.testing.assert_allclose(np.asarray(out[:, 0]), np.asarray(x[:, 0]), atol=1e-6)
+    # relative property: shifting positions rotates q,k equally -> same scores
+    q = jax.random.normal(jax.random.PRNGKey(4), (b, s, nh, hd))
+    s1 = jnp.einsum("bqhd,bkhd->bhqk", apply_rotary(q, pos), apply_rotary(x, pos))
+    s2 = jnp.einsum("bqhd,bkhd->bhqk", apply_rotary(q, pos + 7), apply_rotary(x, pos + 7))
+    np.testing.assert_allclose(np.asarray(s1), np.asarray(s2), atol=1e-3)

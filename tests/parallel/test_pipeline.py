@@ -1,0 +1,92 @@
+"""Pipeline-parallel correctness (reference pattern: tests/core/test_pp.py —
+build a baseline, train both a few steps, compare losses)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from galvatron_tpu.config.strategy import HybridParallelConfig, LayerStrategy
+from galvatron_tpu.models import base as M
+from galvatron_tpu.parallel.pipeline import (
+    stack_params,
+    unstack_params,
+    validate_pipeline_config,
+)
+from galvatron_tpu.runtime.model_api import construct_hybrid_parallel_model
+from galvatron_tpu.runtime.optimizer import OptimizerArgs, get_optimizer_and_scheduler
+
+pytestmark = [pytest.mark.parallel, pytest.mark.distributed]
+
+B, S, V = 8, 32, 128
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return M.TransformerConfig(
+        hidden_size=64, num_heads=4, num_layers=4, vocab_size=V, max_seq_len=64,
+        compute_dtype=jnp.float32,
+    )
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return M.init_model_params(jax.random.PRNGKey(0), cfg)
+
+
+def make_batch(seed):
+    tokens = jax.random.randint(jax.random.PRNGKey(seed), (B, S), 0, V)
+    return dict(
+        tokens=tokens,
+        positions=jnp.broadcast_to(jnp.arange(S), (B, S)),
+        labels=jnp.roll(tokens, -1, 1),
+    )
+
+
+def _traj(cfg, params, hp, devices, steps=3):
+    m = construct_hybrid_parallel_model(cfg, hp, devices)
+    p = jax.tree.map(jnp.copy, params)
+    if hp.pp > 1:
+        p["stages"] = stack_params(p.pop("layers"), hp)
+    p = jax.device_put(p, m.shardings())
+    tx, _ = get_optimizer_and_scheduler(
+        OptimizerArgs(lr=1e-3, warmup_steps=2, total_steps=10, weight_decay=0.0)
+    )
+    st = m.init_opt_state(tx, p)
+    step = m.make_train_step(tx)
+    out = []
+    for i in range(steps):
+        p, st, mets = step(p, st, m.shard_batch(make_batch(i % 2)))
+        out.append(float(mets["loss"]))
+    return out
+
+
+@pytest.mark.parametrize(
+    "pp,tp,chunks",
+    [(2, 1, 2), (4, 1, 4), (2, 2, 2), (2, 1, 1)],
+)
+def test_pipeline_matches_dp(cfg, params, devices8, pp, tp, chunks):
+    ref = _traj(cfg, params, HybridParallelConfig.uniform(8, 4, global_bsz=B, chunks=chunks), devices8)
+    hp = HybridParallelConfig.uniform(8, 4, pp=pp, tp=tp, global_bsz=B, chunks=chunks)
+    got = _traj(cfg, params, hp, devices8)
+    assert max(abs(a - b) for a, b in zip(ref, got)) < 5e-5, (ref, got)
+
+
+def test_stack_unstack_roundtrip(cfg, params):
+    hp = HybridParallelConfig.uniform(8, 4, pp=2, global_bsz=B, chunks=2)
+    stacked = stack_params(params["layers"], hp)
+    back = unstack_params(stacked, hp)
+    for a, b in zip(jax.tree.leaves(params["layers"]), jax.tree.leaves(back)):
+        assert (a == b).all()
+
+
+def test_pipeline_validation():
+    hp = HybridParallelConfig(
+        world_size=8, pp=2,
+        layers=[LayerStrategy(tp=2), LayerStrategy(tp=2), LayerStrategy(tp=1), LayerStrategy(tp=1)],
+        global_bsz=8, chunks=2,
+    )
+    with pytest.raises(ValueError, match="same strategy"):
+        validate_pipeline_config(hp)
+    hp2 = HybridParallelConfig.uniform(8, 4, pp=2, cp=2, global_bsz=8)
+    with pytest.raises(ValueError, match="cp>1"):
+        validate_pipeline_config(hp2)
