@@ -1,0 +1,87 @@
+"""Benchmark: transformer-layer forward time on the real TPU chip.
+
+Metric matches the one concrete number the reference ships (BASELINE.md):
+GPT layer (hidden=4096, heads=32, seq=2048, bf16) forward time per layer per
+sample = 5.331 ms on the authors' GPU
+(reference: models/gpt_hf/configs/computation_profiling_bf16_hidden4096_head32_seqlen2048.json).
+
+Methodology mirrors the reference profiler's layer differencing
+(model_profiler.py:328-372): time N_hi and N_lo layer stacks, per-layer time
+= (T_hi - T_lo) / (N_hi - N_lo) / batch_size.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline = reference_ms / measured_ms (>1 = faster than the reference's
+GPU measurement).
+"""
+
+import json
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+REFERENCE_MS_PER_LAYER_PER_SAMPLE = 5.331
+
+HIDDEN, HEADS, SEQ = 4096, 32, 2048
+BATCH = 8
+N_LO, N_HI = 1, 3
+WARMUP, ITERS = 3, 10
+
+
+def build_stack(n_layers):
+    from galvatron_tpu.models import base as M
+
+    cfg = M.TransformerConfig(
+        hidden_size=HIDDEN, num_heads=HEADS, num_layers=n_layers, vocab_size=256,
+        max_seq_len=SEQ, norm_type="layernorm", activation="gelu",
+        position_type="learned", compute_dtype=jnp.bfloat16,
+        param_dtype=jnp.bfloat16,
+    )
+    key = jax.random.PRNGKey(0)
+    layers = [M.init_layer_params(k, cfg) for k in jax.random.split(key, n_layers)]
+    x = jax.random.normal(jax.random.PRNGKey(1), (BATCH, SEQ, HIDDEN), jnp.bfloat16)
+    positions = jnp.broadcast_to(jnp.arange(SEQ), (BATCH, SEQ))
+
+    def fwd(layers, x):
+        for lp in layers:
+            x = M.layer_forward(lp, x, positions, cfg)
+        # reduce to a scalar so the timing sync transfers O(1) bytes
+        return jnp.sum(x.astype(jnp.float32))
+
+    return jax.jit(fwd), layers, x
+
+
+def time_stack(n_layers):
+    fwd, layers, x = build_stack(n_layers)
+    # NB: block_until_ready does not reliably block on the experimental axon
+    # tunnel backend; a host transfer of the scalar result does.
+    for _ in range(WARMUP):
+        float(fwd(layers, x))
+    times = []
+    for _ in range(ITERS):
+        t0 = time.perf_counter()
+        float(fwd(layers, x))
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def main():
+    t_lo = time_stack(N_LO)
+    t_hi = time_stack(N_HI)
+    per_layer_per_sample_ms = (t_hi - t_lo) / (N_HI - N_LO) / BATCH * 1e3
+    print(
+        json.dumps(
+            {
+                "metric": "gpt_layer_fwd_ms_per_layer_per_sample_h4096_s2048_bf16",
+                "value": round(per_layer_per_sample_ms, 4),
+                "unit": "ms",
+                "vs_baseline": round(REFERENCE_MS_PER_LAYER_PER_SAMPLE / per_layer_per_sample_ms, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

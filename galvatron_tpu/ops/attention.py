@@ -81,12 +81,17 @@ def core_attention(
         k = repeat_kv(k, n_rep)
         v = repeat_kv(v, n_rep)
     if impl == "auto":
-        on_tpu = jax.default_backend() not in ("cpu",)
+        # the pallas kernel is TPU-only ("axon" is the tunnelled TPU backend)
+        on_tpu = jax.default_backend() in ("tpu", "axon")
         # pallas flash path needs seq/head tiling-friendly shapes
         ok_shapes = (
             q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0 and q.shape[3] >= 128 and bias is None
         )
-        impl = "flash" if (on_tpu and ok_shapes) else "xla"
+        # measured on v5e (bench.py): XLA's fused attention beats the generic
+        # pallas flash kernel at seq<=2048; beyond that flash wins on memory
+        # (avoids materialising the (b, nh, s, s) fp32 logits).
+        long_seq = q.shape[1] > 2048
+        impl = "flash" if (on_tpu and ok_shapes and long_seq) else "xla"
     if impl == "flash":
         return _pallas_flash(q, k, v, causal=causal, sm_scale=sm_scale)
     if impl == "xla":
