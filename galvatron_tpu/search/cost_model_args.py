@@ -1,0 +1,78 @@
+"""Cost-model argument bundles (reference: galvatron/core/search_engine/
+cost_model_args.py:6-49). Field names keep the reference vocabulary so
+profiled configs and tests translate directly; semantics are retargeted to
+TPU where noted."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+
+@dataclass
+class ModelArgs:
+    parameter_size: float = 48.0  # MB per layer at tp=1
+    seq_length: int = 2048
+    hidden_size: int = 4096
+    layer_num: int = 24
+    # multi-layer-type models (T5): per-type lists are built by the engine
+
+
+@dataclass
+class TrainArgs:
+    mixed_precision: bool = True
+    async_grad_reduce: bool = True
+    # XLA/TPU runtime reservation (cudnn/pytorch context analogue; covers the
+    # XLA runtime + compiled-program buffers), MB
+    runtime_context_mem: float = 512.0
+
+
+@dataclass
+class ParallelArgs:
+    use_zero2_for_dp: bool = False
+    max_tp_deg: int = 8
+    disable_vtp: bool = False
+    sequence_parallel: bool = True
+    sp_space: str = "tp"  # tp | tp+sp | sp
+    pipeline_type: str = "gpipe"
+    optimal_chunk_func: Optional[Callable] = None
+    chunks: Optional[int] = None
+
+
+@dataclass
+class ProfileModelArgs:
+    # per-layer forward time: scalar ms/layer/sample, or (m, c) linear fit in
+    # per-tp batch (profile_mode=batch), or quadratic fit in seq
+    forward_computation_time: Any = 5.0
+    # activation MB per sample keyed by tp degree (str or int) + 'checkpoint'
+    tp_activation_per_bsz_dict: Dict[Any, float] = field(default_factory=dict)
+    other_memory_pp_off: Dict[str, Dict[Any, float]] = field(default_factory=dict)
+    other_memory_pp_on: Dict[str, Dict[str, Dict[Any, float]]] = field(default_factory=dict)
+    other_time_profiled: Any = 1.0  # ms for embed+cls forward per sample
+
+
+@dataclass
+class ProfileHardwareArgs:
+    bct_fct_coe: float = 2.0  # backward/forward flops ratio
+    extra_overhead: float = 0.0  # ms per iteration fixed overhead
+    # allreduce cost coefficients: ms per MB, keyed '%d' / '%d_0' / '%d_1'
+    # (group size x minor/major mesh-axis placement; on TPU "consec"(_1) means
+    # the group rides contiguous minor ICI axes, "nonconsec"(_0) major axes)
+    comm_coe_dict: Dict[str, float] = field(default_factory=dict)
+    dp_overlap_coe: float = 1.1  # collective slowdown when overlapped
+    bct_overlap_coe: float = 1.1  # compute slowdown when overlapped
+    p2p_comm_coe_dict: Optional[Dict[int, float]] = None  # ms/MB per pp degree
+    costmodel_coe: float = 1.0
+    # per-degree collective time tables: {deg: {"popt": (m, c)}} in ms vs MB
+    allreduce_dict: Dict[int, Dict[str, Any]] = field(default_factory=dict)
+    all2all_dict: Dict[int, Dict[str, Any]] = field(default_factory=dict)
+
+
+def default_optimal_chunk_func(local_bsz, strategy, mbsz, min_tp):
+    """Reference optimal_chunk_func_default (search_engine.py:1090): chunks
+    so each microbatch is ~mbsz samples."""
+    import math
+
+    if mbsz <= 0:
+        return 1
+    return max(1, int(math.ceil(local_bsz / mbsz)))

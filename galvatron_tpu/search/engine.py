@@ -1,0 +1,414 @@
+"""Search engine driver.
+
+Re-design of the reference `GalvatronSearchEngine`
+(galvatron/core/search_engine/search_engine.py:24-1103): loads profiled
+model/hardware JSONs, generates the strategy space, runs the DP per
+(bsz, chunks, min_tp, vsp, embed_sdp) combination, and saves the winner as a
+runtime-loadable strategy JSON (HybridParallelConfig schema).
+
+Pure CPU — no jax/accelerator required (the reference preserves the same
+property; SURVEY.md §4)."""
+
+from __future__ import annotations
+
+import copy
+import math
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from galvatron_tpu.config.strategy import HybridParallelConfig, LayerStrategy
+from galvatron_tpu.search.cost_model import (
+    MemoryCostModel,
+    OtherTimeCostModel,
+    TimeCostModel,
+)
+from galvatron_tpu.search.cost_model_args import (
+    ModelArgs,
+    ParallelArgs,
+    ProfileHardwareArgs,
+    ProfileModelArgs,
+    TrainArgs,
+)
+from galvatron_tpu.search.dynamic_programming import DpOnModel
+from galvatron_tpu.utils.jsonio import read_json_config, write_json_config
+from galvatron_tpu.utils.strategy_utils import form_strategy
+
+
+@dataclass
+class SearchArgs:
+    """Search flags (reference search_engine/arguments.py:1-146)."""
+
+    memory_constraint: float = 16.0  # GB per chip HBM budget
+    search_space: str = "full"  # full | dp+tp | dp+pp | 3d | dp | sdp | tp | pp
+    sp_space: str = "tp"  # tp+sp | tp | sp
+    disable_dp: bool = False
+    disable_tp: bool = False
+    disable_vtp: bool = False
+    disable_pp: bool = False
+    disable_sdp: bool = False
+    disable_ckpt: bool = False
+    disable_tp_consec: bool = False
+    disable_cp: bool = True  # context parallel search (off by default, as ref)
+    max_tp_deg: int = 8
+    max_pp_deg: int = 8
+    max_cp_deg: int = 4
+    min_bsz: int = 8
+    max_bsz: Optional[int] = None
+    bsz_scale: int = 8
+    settle_bsz: Optional[int] = None
+    settle_chunk: Optional[int] = None
+    fine_grained_mode: bool = True
+    use_pipeline_costmodel: bool = False
+    mixed_precision: bool = True
+    default_dp_type: str = "ddp"
+    embed_sdp: int = -1  # -1: search both; 0/1: fixed
+    vsp: int = -1  # -1: search both; 0/1: fixed
+    mem_cache_gb: float = 0.0
+    costmodel_coe: float = 1.0
+
+
+def generate_strategies(world_size: int, args: SearchArgs) -> List[list]:
+    """Enumerate [pp, tp, dp, info] strategies (reference
+    search_engine.py:783-914). Degrees are powers of two."""
+
+    def pow2s(limit):
+        out, k = [], 1
+        while k <= limit:
+            out.append(k)
+            k *= 2
+        return out
+
+    space = args.search_space
+    strategies = []
+    for pp in pow2s(min(args.max_pp_deg, world_size)):
+        if args.disable_pp and pp > 1:
+            continue
+        if space in ("dp", "sdp", "tp", "dp+tp") and pp > 1:
+            continue
+        per_stage = world_size // pp
+        if per_stage * pp != world_size:
+            continue
+        for tp in pow2s(min(args.max_tp_deg, per_stage)):
+            if args.disable_tp and tp > 1:
+                continue
+            if space in ("dp", "sdp", "pp", "dp+pp") and tp > 1:
+                continue
+            cps = pow2s(min(args.max_cp_deg, per_stage // tp)) if not args.disable_cp else [1]
+            for cp in cps:
+                dp = per_stage // tp // cp
+                if dp * tp * cp != per_stage:
+                    continue
+                if args.disable_dp and dp > 1:
+                    continue
+                if space == "tp" and dp > 1:
+                    continue
+                base_infos: List[dict] = [{}]
+                # tp consecutive placement choice (minor vs major ICI axes)
+                if tp > 1 and dp > 1 and not args.disable_tp_consec:
+                    base_infos = [{"tp": 1}, {"tp": 0}]
+                elif tp > 1:
+                    base_infos = [{"tp": 1}]
+                # megatron-tp vs ulysses-sp per layer
+                sp_flags = [0]
+                if tp > 1 and args.sp_space == "tp+sp":
+                    sp_flags = [0, 1]
+                elif tp > 1 and args.sp_space == "sp":
+                    sp_flags = [1]
+                for info0 in base_infos:
+                    for spf in sp_flags:
+                        for fsdp in ([0] if (args.disable_sdp or space in ("dp", "tp", "pp")) else [0, 1]):
+                            if space == "sdp" and not fsdp and dp > 1:
+                                continue
+                            for cpt in [0] if args.disable_ckpt else [0, 1]:
+                                info = dict(info0)
+                                if spf:
+                                    info["sp"] = 1
+                                    info.pop("tp", None)
+                                if fsdp:
+                                    info["fsdp"] = 1
+                                if cpt:
+                                    info["cpt"] = 1
+                                if cp > 1:
+                                    info["cp"] = cp
+                                strategies.append([pp, tp, dp, info])
+    # dedupe
+    seen, out = set(), []
+    for s in strategies:
+        key = (s[0], s[1], s[2], tuple(sorted(s[3].items())))
+        if key not in seen:
+            seen.add(key)
+            out.append(s)
+    return out
+
+
+def pp_division_memory_balanced(
+    memory_cost_list: List[float], pp_deg: int
+) -> List[int]:
+    """Split layers into pp_deg contiguous groups with balanced summed memory
+    (reference search_engine.py:972-1088, greedy re-implementation)."""
+    n = len(memory_cost_list)
+    if pp_deg == 1:
+        return [n]
+    total = float(np.sum(memory_cost_list))
+    target = total / pp_deg
+    division, acc, count = [], 0.0, 0
+    for i, m in enumerate(memory_cost_list):
+        remaining_stages = pp_deg - len(division)
+        remaining_layers = n - i
+        if len(division) < pp_deg - 1 and (
+            acc + m / 2 >= target or remaining_layers <= (remaining_stages - 1)
+        ) and count > 0:
+            division.append(count)
+            acc, count = 0.0, 0
+        acc += m
+        count += 1
+    division.append(count)
+    while len(division) < pp_deg:
+        # split the largest group
+        j = int(np.argmax(division))
+        if division[j] < 2:
+            return [n // pp_deg] * (pp_deg - 1) + [n - n // pp_deg * (pp_deg - 1)]
+        division[j] -= 1
+        division.insert(j + 1, 1)
+    return division
+
+
+class GalvatronSearchEngine:
+    """profile JSONs -> optimal layer-wise strategy JSON."""
+
+    def __init__(
+        self,
+        args: SearchArgs,
+        world_size: int,
+        model_layer_configs: List[dict],
+        # each: {"hidden_size", "seq_len", "layer_num"}
+        config_dir: str = "configs",
+        model_name: str = "model",
+        logger=None,
+    ):
+        self.args = args
+        self.world_size = world_size
+        self.layer_configs = model_layer_configs
+        self.num_layertype = len(model_layer_configs)
+        self.config_dir = config_dir
+        self.model_name = model_name
+        self.logger = logger
+        self.strategies: List[list] = []
+        self.optimal_chunk_func = None
+
+    # --------------------------------------------------------------- loading
+    def set_model_profiles(self, time_config: dict, memory_config: dict):
+        """Processed profiling tables, one entry per layer type.
+
+        time_config:  {"layertype_%d": ms-per-layer-per-sample | [m,c] fit,
+                       "other_time": ms | [m,c]}
+        memory_config: {"layertype_%d": {"parameter_size": MB,
+                        "tp_activation_per_bsz_dict": {tp: MB, 'checkpoint': MB}},
+                        "other_memory_pp_off": {...}, "other_memory_pp_on": {...}}
+        """
+        self.time_config = time_config
+        self.memory_config = memory_config
+
+    def set_hardware_profiles(
+        self,
+        allreduce_bandwidth_config: dict,
+        p2p_bandwidth_config: Optional[dict] = None,
+        overlap_config: Optional[dict] = None,
+        sp_time_config: Optional[dict] = None,
+    ):
+        """Hardware JSONs (schemas match the reference hardware profiler:
+        allreduce_bandwidth_*.json keys 'allreduce_size_%d_consec_%d' in GB/s;
+        p2p_bandwidth 'pp_size_%d'; overlap 'overlap_coe')."""
+        self.comm_coe_dict = {}
+        for key, gbps in allreduce_bandwidth_config.items():
+            if not key.startswith("allreduce_size_"):
+                continue
+            rest = key[len("allreduce_size_"):]
+            size_s, consec_s = rest.split("_consec_")
+            tag = size_s if int(consec_s) == 1 and ("allreduce_size_%s_consec_0" % size_s) not in allreduce_bandwidth_config else "%s_%s" % (size_s, consec_s)
+            # ms per MB = 1e3 / (GB/s * 1024)
+            self.comm_coe_dict[tag] = 1000.0 / (float(gbps) * 1024.0)
+        self.comm_coe_dict.setdefault("1", 0.0)
+        self.p2p_coe_dict = {}
+        if p2p_bandwidth_config:
+            for key, gbps in p2p_bandwidth_config.items():
+                if key.startswith("pp_size_"):
+                    self.p2p_coe_dict[int(key[len("pp_size_"):])] = 1000.0 / (float(gbps) * 1024.0)
+        self.overlap_coe = float((overlap_config or {}).get("overlap_coe", 1.1))
+        self.allreduce_dict = (sp_time_config or {}).get("allreduce", {})
+        self.all2all_dict = (sp_time_config or {}).get("all2all", {})
+        self.allreduce_dict = {int(k): v for k, v in self.allreduce_dict.items()}
+        self.all2all_dict = {int(k): v for k, v in self.all2all_dict.items()}
+
+    # ------------------------------------------------------------- arg bundles
+    def _bundles(self, chunks: Optional[int]):
+        a = self.args
+        ma_list, ta_list, pa_list, pma_list, pha_list = [], [], [], [], []
+        for t, lc in enumerate(self.layer_configs):
+            ma_list.append(
+                ModelArgs(
+                    parameter_size=self.memory_config["layertype_%d" % t]["parameter_size"],
+                    seq_length=lc["seq_len"],
+                    hidden_size=lc["hidden_size"],
+                    layer_num=lc["layer_num"],
+                )
+            )
+            ta_list.append(TrainArgs(mixed_precision=a.mixed_precision))
+            pa_list.append(
+                ParallelArgs(
+                    use_zero2_for_dp=(a.default_dp_type == "zero2"),
+                    max_tp_deg=a.max_tp_deg,
+                    disable_vtp=a.disable_vtp,
+                    sequence_parallel=True,
+                    sp_space=a.sp_space,
+                    chunks=chunks,
+                )
+            )
+            pma_list.append(
+                ProfileModelArgs(
+                    forward_computation_time=self.time_config["layertype_%d" % t],
+                    tp_activation_per_bsz_dict=self.memory_config["layertype_%d" % t][
+                        "tp_activation_per_bsz_dict"
+                    ],
+                    other_memory_pp_off=self.memory_config.get("other_memory_pp_off", {}),
+                    other_memory_pp_on=self.memory_config.get("other_memory_pp_on", {}),
+                    other_time_profiled=self.time_config.get("other_time", 1.0),
+                )
+            )
+            pha_list.append(
+                ProfileHardwareArgs(
+                    comm_coe_dict=self.comm_coe_dict,
+                    dp_overlap_coe=self.overlap_coe,
+                    bct_overlap_coe=self.overlap_coe,
+                    p2p_comm_coe_dict=self.p2p_coe_dict,
+                    allreduce_dict=self.allreduce_dict,
+                    all2all_dict=self.all2all_dict,
+                    costmodel_coe=self.args.costmodel_coe,
+                )
+            )
+        return ma_list, ta_list, pa_list, pma_list, pha_list
+
+    # ------------------------------------------------------------------ search
+    def initialize_search_engine(self):
+        self.strategies = generate_strategies(self.world_size, self.args)
+        return self.strategies
+
+    def _pp_stage_dict(self, bundles) -> Dict[int, List[int]]:
+        """Memory-balanced layer division per pp degree, using each layer's
+        tp=1 zero-free memory as weight."""
+        ma_list, ta_list, pa_list, pma_list, _ = bundles
+        weights = []
+        for t, lc in enumerate(self.layer_configs):
+            m = MemoryCostModel(
+                [1, 1, self.world_size, {}], global_batch_size=self.args.min_bsz,
+                mbsz=1, min_tp=1, max_tp=self.args.max_tp_deg,
+                model_args=ma_list[t], train_args=ta_list[t], parallel_args=pa_list[t],
+                profile_model_args=pma_list[t],
+            ).get_memory_cost()["enc_total"]
+            weights += [m] * lc["layer_num"]
+        out = {}
+        for pp in sorted({s[0] for s in self.strategies}):
+            out[pp] = pp_division_memory_balanced(weights, pp)
+        return out
+
+    def search_for_bsz_chunk(self, bsz: int, chunks: int, min_tp: int = 1,
+                             vsp: int = 0, embed_sdp: bool = False):
+        bundles = self._bundles(chunks)
+        ma_list, ta_list, pa_list, pma_list, pha_list = bundles
+        dpom = DpOnModel(
+            self.strategies,
+            MemoryCostModel,
+            TimeCostModel,
+            OtherTimeCostModel,
+            ma_list, ta_list, pa_list, pma_list, pha_list,
+            max_mem=int(self.args.memory_constraint * 1024),
+            layer_nums=[lc["layer_num"] for lc in self.layer_configs],
+            multi_layer_type=self.num_layertype > 1,
+            pp_stage_dict=self._pp_stage_dict(bundles),
+            comm_coe_dict=self.comm_coe_dict,
+            gpu_num=self.world_size,
+            mem_cache_mb=int(self.args.mem_cache_gb * 1024),
+            fine_grained_mode=self.args.fine_grained_mode,
+            sequence_len=[lc["seq_len"] for lc in self.layer_configs],
+            logger=self.logger,
+        )
+        cost, res, rem, vtp, pp = dpom.fit(
+            bsz, mbsz=max(1, bsz // self.world_size), min_tp=min_tp,
+            max_tp=self.args.max_tp_deg, vsp=vsp, embed_sdp=embed_sdp, chunks=chunks,
+        )
+        return dict(cost=cost, strategies=res, remaining=rem, vtp=vtp, pp=pp,
+                    bsz=bsz, chunks=chunks, vsp=vsp, embed_sdp=embed_sdp,
+                    pp_division=dpom.pp_stage_dict.get(pp))
+
+    def parallelism_optimization(self) -> Optional[dict]:
+        """Outer loop over bsz x chunks x vsp x embed_sdp (reference
+        search_engine.py:339-537). Maximises throughput = bsz / iter_time."""
+        a = self.args
+        best, best_throughput = None, -1.0
+        bszs = [a.settle_bsz] if a.settle_bsz else list(
+            range(a.min_bsz, (a.max_bsz or a.min_bsz * 8) + 1, a.bsz_scale)
+        )
+        chunk_opts = [a.settle_chunk] if a.settle_chunk else [1, 2, 4, 8]
+        vsp_opts = [a.vsp] if a.vsp in (0, 1) else ([0, 1] if a.sp_space in ("sp", "tp+sp") else [0])
+        esdp_opts = [bool(a.embed_sdp)] if a.embed_sdp in (0, 1) else [False, True]
+        for bsz in bszs:
+            for chunks in chunk_opts:
+                if bsz % chunks != 0:
+                    continue
+                for vsp in vsp_opts:
+                    for embed_sdp in esdp_opts:
+                        r = self.search_for_bsz_chunk(bsz, chunks, vsp=vsp, embed_sdp=embed_sdp)
+                        if r["strategies"] is None or not np.isfinite(r["cost"]):
+                            continue
+                        throughput = bsz / r["cost"]
+                        if throughput > best_throughput:
+                            best, best_throughput = r, throughput
+        self.best = best
+        return best
+
+    # ------------------------------------------------------------------- save
+    def result_to_config(self, result: dict) -> HybridParallelConfig:
+        layers = []
+        for s in result["strategies"]:
+            info = s[3] if len(s) > 3 else {}
+            layers.append(
+                LayerStrategy(
+                    tp=s[1],
+                    cp=info.get("cp", 1),
+                    sp=info.get("sp", 0),
+                    fsdp=info.get("fsdp", 0),
+                    checkpoint=info.get("cpt", 0),
+                    tp_consec=info.get("tp", 1),
+                )
+            )
+        return HybridParallelConfig(
+            world_size=self.world_size,
+            pp=result["pp"],
+            layers=layers,
+            global_bsz=result["bsz"],
+            chunks=result["chunks"],
+            pp_division=result.get("pp_division"),
+            pipeline_type="pipedream_flush" if result["pp"] > 1 else "gpipe",
+            default_dp_type=self.args.default_dp_type,
+            vocab_tp=result["vtp"] if result["vtp"] > 0 else 1,
+            vocab_sp=result["vsp"],
+            embed_sdp=int(result["embed_sdp"]),
+        )
+
+    def save_results(self, result: dict, path: Optional[str] = None) -> str:
+        cfg = self.result_to_config(result)
+        path = path or os.path.join(
+            self.config_dir,
+            "galvatron_config_%s_%dgpus_%dGB_%s.json"
+            % (
+                self.model_name,
+                self.world_size,
+                int(self.args.memory_constraint),
+                "bf16" if self.args.mixed_precision else "fp32",
+            ),
+        )
+        cfg.save(path)
+        return path

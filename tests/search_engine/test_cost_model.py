@@ -1,0 +1,123 @@
+"""Cost-model behavior (reference tests/search_engine/test_cost_model.py:19-60
+style: parametrised strategy cases over mock profiled configs)."""
+
+import numpy as np
+import pytest
+
+from galvatron_tpu.search.cost_model import MemoryCostModel, TimeCostModel, comm_coe
+from galvatron_tpu.search.cost_model_args import (
+    ModelArgs,
+    ParallelArgs,
+    ProfileHardwareArgs,
+    ProfileModelArgs,
+    TrainArgs,
+)
+
+pytestmark = [pytest.mark.search_engine]
+
+ACT = {1: 500.0, 2: 260.0, 4: 140.0, 8: 80.0, "checkpoint": 30.0}
+OTHER_OFF = {"model_states": {1: 1000.0, 2: 500.0, 4: 250.0}, "activation": {1: 80.0, 2: 42.0, 4: 22.0}}
+OTHER_ON = {
+    "first_stage": {"model_states": {1: 600.0, 2: 300.0, 4: 150.0}, "activation": {1: 50.0, 2: 26.0, 4: 14.0}},
+    "last_stage": {"model_states": {1: 400.0, 2: 200.0, 4: 100.0}, "activation": {1: 30.0, 2: 16.0, 4: 8.0}},
+}
+COMM = {"8": 0.01, "4_0": 0.012, "4_1": 0.011, "2_0": 0.014, "2_1": 0.013, "1": 0.0}
+
+
+def mk(strategy, bsz=8, chunks=1, use_zero2=False, **kw):
+    return MemoryCostModel(
+        strategy, global_batch_size=bsz, mbsz=1, min_tp=1, max_tp=4,
+        model_args=ModelArgs(parameter_size=48.0, layer_num=8),
+        train_args=TrainArgs(),
+        parallel_args=ParallelArgs(chunks=chunks, use_zero2_for_dp=use_zero2),
+        profile_model_args=ProfileModelArgs(
+            tp_activation_per_bsz_dict=ACT,
+            other_memory_pp_off=OTHER_OFF,
+            other_memory_pp_on=OTHER_ON,
+        ),
+        **kw,
+    ).get_memory_cost()
+
+
+def tk(strategy, bsz=8, **kw):
+    return TimeCostModel(
+        strategy, global_batch_size=bsz,
+        model_args=ModelArgs(parameter_size=48.0, seq_length=2048, hidden_size=4096, layer_num=8),
+        train_args=TrainArgs(),
+        parallel_args=ParallelArgs(),
+        profile_model_args=ProfileModelArgs(forward_computation_time=5.0),
+        profile_hardware_args=ProfileHardwareArgs(comm_coe_dict=COMM, p2p_comm_coe_dict={2: 0.01, 4: 0.012}),
+        **kw,
+    ).gen_result()
+
+
+def test_tp_divides_parameters():
+    m1 = mk([1, 1, 8, {}])
+    m2 = mk([1, 2, 4, {}])
+    assert np.isclose(m2["parameter"], m1["parameter"] / 2)
+    # ulysses keeps full parameters
+    m3 = mk([1, 2, 4, {"sp": 1}])
+    assert np.isclose(m3["parameter"], m1["parameter"])
+
+
+def test_zero_ratios_ordering():
+    ddp = mk([1, 1, 8, {}])["model_states"]
+    z2 = mk([1, 1, 8, {}], use_zero2=True)["model_states"]
+    z3 = mk([1, 1, 8, {"fsdp": 1}])["model_states"]
+    assert z3 < z2 < ddp
+    # zero3 with grad accumulation keeps more state resident
+    z3_acc = mk([1, 1, 8, {"fsdp": 1}], bsz=64, chunks=4)["model_states"]
+    assert z3_acc > z3
+
+
+def test_checkpoint_reduces_activation():
+    base = mk([1, 2, 4, {}])["activation"]
+    ckpt = mk([1, 2, 4, {"cpt": 1}])["activation"]
+    assert ckpt < base
+
+
+def test_chunks_reduce_activation_pp1():
+    # bsz=64 so local_bsz=8 and chunks are not clamped
+    a1 = mk([1, 1, 8, {}], bsz=64, chunks=1)["activation"]
+    a4 = mk([1, 1, 8, {}], bsz=64, chunks=4)["activation"]
+    assert a4 < a1
+    # scan pipeline (pp>1) holds the whole local batch regardless of chunks
+    p1 = mk([2, 1, 4, {}], bsz=64, chunks=1)["activation"]
+    p4 = mk([2, 1, 4, {}], bsz=64, chunks=4)["activation"]
+    assert np.isclose(p1, p4)
+
+
+def test_other_memory_has_vtp_candidates_and_stages():
+    other = mk([2, 2, 2, {}], bsz=8)["other"]
+    assert set(other.keys()) >= {1, 2}
+    assert len(other[1]) == 2  # per-stage
+    assert other[1][0] > 0 and other[1][-1] > 0
+
+
+def test_time_comm_overhead_positive():
+    # strategies at the same pp pay for their collectives vs a no-comm run
+    t_tp = tk([1, 8, 1, {}])
+    t_tp_nc = tk([1, 8, 1, {}], no_comm=True)
+    assert t_tp > t_tp_nc
+    t_dp = tk([1, 1, 8, {}])
+    t_dp_nc = tk([1, 1, 8, {}], no_comm=True)
+    assert t_dp > t_dp_nc
+
+
+def test_time_checkpoint_adds_recompute():
+    base = tk([1, 2, 4, {"tp": 1}])
+    ck = tk([1, 2, 4, {"tp": 1, "cpt": 1}])
+    assert ck > base
+
+
+def test_fsdp_adds_allgather_time():
+    base = tk([1, 1, 8, {}])
+    f = tk([1, 1, 8, {"fsdp": 1}])
+    assert f > base
+
+
+def test_comm_coe_placement():
+    assert comm_coe(COMM, 4, consec=True) == 0.011
+    assert comm_coe(COMM, 4, consec=False) == 0.012
+    assert comm_coe(COMM, 8) == 0.01
+    assert comm_coe(COMM, 1) == 0.0

@@ -1,0 +1,113 @@
+"""DP algorithm: C++ core vs numpy fallback, known-optimum instances
+(reference test style: tests/search_engine/, pure CPU)."""
+
+import numpy as np
+import pytest
+
+from galvatron_tpu.search.dynamic_programming import DPAlg, _load_core
+
+pytestmark = [pytest.mark.search_engine]
+
+
+def _rand_instance(rng, L=6, M=64, S=4):
+    v = rng.randint(1, M // (L + 1), size=(L, S))
+    intra = rng.rand(L, S) * 10
+    inter = rng.rand(L, S, S) * 2
+    inter[0] = 0
+    return v, intra, inter
+
+
+def test_cpp_core_builds():
+    assert _load_core() is not None, "native dp core failed to build/load"
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_cpp_matches_numpy(seed):
+    rng = np.random.RandomState(seed)
+    v, intra, inter = _rand_instance(rng)
+    other_mem = {1: 2, 2: 10}
+    other_time = {1: 0.5, 2: 0.1}
+    results = {}
+    for use_cpp in (True, False):
+        alg = DPAlg(max_mem=63, other_mem_cost=other_mem, other_time_cost=other_time,
+                    layer_num=v.shape[0], strategy_num=v.shape[1], use_cpp_core=use_cpp)
+        alg.set_v_and_cost(v, intra, inter)
+        results[use_cpp] = alg.fit()
+    tc_c, res_c, rem_c = results[True]
+    tc_py, res_py, rem_py = results[False]
+    for k in other_mem:
+        assert np.isclose(tc_c[k], tc_py[k]), (k, tc_c, tc_py)
+        assert rem_c[k] == rem_py[k]
+        assert res_c[k] == res_py[k]
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_cpp_matches_numpy_with_zero_need(seed):
+    """v_data entries of 0 (sub-MB layers) must not alias the DP table
+    (dp_core.cpp double-buffers the previous layer's row)."""
+    rng = np.random.RandomState(seed)
+    v, intra, inter = _rand_instance(rng)
+    v[rng.rand(*v.shape) < 0.4] = 0
+    results = {}
+    for use_cpp in (True, False):
+        alg = DPAlg(max_mem=63, other_mem_cost={1: 2}, other_time_cost={1: 0.0},
+                    layer_num=v.shape[0], strategy_num=v.shape[1], use_cpp_core=use_cpp)
+        alg.set_v_and_cost(v, intra, inter)
+        results[use_cpp] = alg.fit()
+    assert np.isclose(results[True][0][1], results[False][0][1])
+    assert results[True][1][1] == results[False][1][1]
+
+
+def test_known_optimum():
+    # 2 layers, 2 strategies: s0 cheap mem/slow, s1 big mem/fast.
+    v = np.array([[1, 8], [1, 8]])
+    intra = np.array([[10.0, 1.0], [10.0, 1.0]])
+    inter = np.zeros((2, 2, 2))
+    # budget allows one layer on s1 only -> expect one s1, one s0
+    alg = DPAlg(max_mem=10, other_mem_cost={1: 0}, other_time_cost={1: 0.0},
+                layer_num=2, strategy_num=2)
+    alg.set_v_and_cost(v, intra, inter)
+    tc, res, rem = alg.fit()
+    assert sorted(res[1]) == [0, 1]
+    assert np.isclose(tc[1], 11.0)
+    # generous budget -> both on s1
+    alg = DPAlg(max_mem=40, other_mem_cost={1: 0}, other_time_cost={1: 0.0},
+                layer_num=2, strategy_num=2)
+    alg.set_v_and_cost(v, intra, inter)
+    tc, res, rem = alg.fit()
+    assert res[1] == [1, 1] and np.isclose(tc[1], 2.0)
+    assert rem[1] == 40 - 16
+
+
+def test_transition_cost_steers_uniformity():
+    # equal intra costs; switching strategies costs 5 -> stays uniform
+    v = np.ones((3, 2), dtype=int)
+    intra = np.ones((3, 2))
+    inter = np.zeros((3, 2, 2))
+    for i in (1, 2):
+        inter[i] = np.array([[0.0, 5.0], [5.0, 0.0]])
+    alg = DPAlg(max_mem=20, other_mem_cost={1: 0}, other_time_cost={1: 0.0},
+                layer_num=3, strategy_num=2)
+    alg.set_v_and_cost(v, intra, inter)
+    tc, res, rem = alg.fit()
+    assert res[1] in ([0, 0, 0], [1, 1, 1])
+
+
+def test_infeasible_budget():
+    v = np.full((2, 2), 50)
+    alg = DPAlg(max_mem=10, other_mem_cost={1: 0}, other_time_cost={1: 0.0},
+                layer_num=2, strategy_num=2)
+    alg.set_v_and_cost(v, np.ones((2, 2)), np.zeros((2, 2, 2)))
+    tc, res, rem = alg.fit()
+    assert not np.isfinite(tc[1]) and res[1] is None and rem[1] == -1
+
+
+def test_vtp_selection_by_other_cost():
+    v = np.ones((2, 2), dtype=int)
+    intra = np.ones((2, 2))
+    inter = np.zeros((2, 2, 2))
+    alg = DPAlg(max_mem=30, other_mem_cost={1: 1, 2: 1}, other_time_cost={1: 9.0, 2: 0.5},
+                layer_num=2, strategy_num=2)
+    alg.set_v_and_cost(v, intra, inter)
+    tc, res, rem = alg.fit()
+    assert tc[2] < tc[1]

@@ -1,0 +1,120 @@
+"""End-to-end search over mock profiles (reference
+tests/search_engine/test_parallelsim_optimization.py style, pure CPU)."""
+
+import numpy as np
+import pytest
+
+from galvatron_tpu.config.strategy import HybridParallelConfig
+from galvatron_tpu.search.engine import (
+    GalvatronSearchEngine,
+    SearchArgs,
+    generate_strategies,
+    pp_division_memory_balanced,
+)
+
+pytestmark = [pytest.mark.search_engine]
+
+ALLREDUCE_BW = {
+    "allreduce_size_8_consec_1": 150.0,
+    "allreduce_size_4_consec_1": 155.0,
+    "allreduce_size_4_consec_0": 150.0,
+    "allreduce_size_2_consec_1": 130.0,
+    "allreduce_size_2_consec_0": 145.0,
+}
+P2P_BW = {"pp_size_2": 160.0, "pp_size_4": 140.0, "pp_size_8": 110.0}
+TIME_CONFIG = {"layertype_0": 5.3, "other_time": 2.0}
+MEMORY_CONFIG = {
+    "layertype_0": {
+        "parameter_size": 96.0,
+        "tp_activation_per_bsz_dict": {1: 500.0, 2: 260.0, 4: 140.0, 8: 80.0, "checkpoint": 30.0},
+    },
+    "other_memory_pp_off": {
+        "model_states": {1: 3000.0, 2: 1500.0, 4: 750.0, 8: 375.0},
+        "activation": {1: 80.0, 2: 42.0, 4: 22.0, 8: 12.0},
+    },
+    "other_memory_pp_on": {
+        "first_stage": {"model_states": {1: 2000.0, 2: 1000.0, 4: 500.0, 8: 250.0},
+                        "activation": {1: 50.0, 2: 26.0, 4: 14.0, 8: 8.0}},
+        "last_stage": {"model_states": {1: 1500.0, 2: 750.0, 4: 375.0, 8: 190.0},
+                       "activation": {1: 30.0, 2: 16.0, 4: 8.0, 8: 5.0}},
+    },
+}
+
+
+def make_engine(mem_gb=16.0, world=8, layers=8, **kw):
+    args = SearchArgs(memory_constraint=mem_gb, settle_bsz=kw.pop("bsz", 16),
+                      settle_chunk=kw.pop("chunk", 2), max_tp_deg=8, **kw)
+    eng = GalvatronSearchEngine(
+        args, world, [{"hidden_size": 4096, "seq_len": 2048, "layer_num": layers}],
+        model_name="mock",
+    )
+    eng.set_model_profiles(TIME_CONFIG, MEMORY_CONFIG)
+    eng.set_hardware_profiles(ALLREDUCE_BW, P2P_BW, {"overlap_coe": 1.12})
+    eng.initialize_search_engine()
+    return eng
+
+
+def test_generate_strategies_filters():
+    args = SearchArgs()
+    s_full = generate_strategies(8, args)
+    assert any(s[0] == 4 for s in s_full)
+    assert any(s[1] == 8 for s in s_full)
+    assert any(s[3].get("fsdp") for s in s_full)
+    s_dp = generate_strategies(8, SearchArgs(search_space="dp"))
+    assert all(s[0] == 1 and s[1] == 1 for s in s_dp)
+    s_notp = generate_strategies(8, SearchArgs(disable_tp=True))
+    assert all(s[1] == 1 for s in s_notp)
+    s_sp = generate_strategies(8, SearchArgs(sp_space="tp+sp"))
+    assert any(s[3].get("sp") for s in s_sp)
+    # degrees multiply back to world size per stage
+    for s in s_full:
+        assert (8 // s[0]) % (s[1] * s[3].get("cp", 1)) == 0
+
+
+def test_pp_division_memory_balanced():
+    costs = [10.0] * 4 + [30.0] * 4
+    div = pp_division_memory_balanced(costs, 2)
+    assert sum(div) == 8 and len(div) == 2
+    # heavier tail -> first stage gets more layers
+    assert div[0] > div[1]
+    assert pp_division_memory_balanced(costs, 1) == [8]
+
+
+def test_search_returns_feasible_config(tmp_path):
+    eng = make_engine(mem_gb=16.0)
+    best = eng.parallelism_optimization()
+    assert best is not None and np.isfinite(best["cost"])
+    path = eng.save_results(best, str(tmp_path / "out.json"))
+    cfg = HybridParallelConfig.from_json(path, world_size=8)
+    assert cfg.num_layers == 8
+    assert cfg.global_bsz == 16
+
+
+def test_tight_memory_forces_sharding_or_ckpt():
+    roomy = make_engine(mem_gb=24.0).parallelism_optimization()
+    tight = make_engine(mem_gb=7.0).parallelism_optimization()
+    assert roomy is not None and tight is not None
+
+    def mem_savers(result):
+        return sum(
+            s[3].get("fsdp", 0) + s[3].get("cpt", 0) + (s[1] > 1) + (s[0] > 1)
+            for s in result["strategies"]
+        )
+
+    assert mem_savers(tight) >= mem_savers(roomy)
+    assert tight["cost"] >= roomy["cost"] - 1e-9  # saving memory costs time
+
+
+def test_infeasible_budget_returns_none():
+    eng = make_engine(mem_gb=0.5)
+    assert eng.parallelism_optimization() is None
+
+
+def test_search_prefers_cheap_comm():
+    """With free compute and expensive comm, pure strategies with less
+    communication should win over tp-heavy ones."""
+    eng = make_engine(mem_gb=64.0)
+    best = eng.parallelism_optimization()
+    tps = {s[1] for s in best["strategies"]}
+    # roomy memory -> no need for tp=8 everywhere
+    assert min(tps) <= 4
