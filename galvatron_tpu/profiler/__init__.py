@@ -1,0 +1,5 @@
+from galvatron_tpu.profiler.hardware import HardwareProfiler
+from galvatron_tpu.profiler.model import ModelProfiler
+from galvatron_tpu.profiler.runtime import RuntimeProfiler
+
+__all__ = ["HardwareProfiler", "ModelProfiler", "RuntimeProfiler"]

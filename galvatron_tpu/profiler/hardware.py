@@ -1,0 +1,302 @@
+"""Hardware profiler: timed JAX collectives over device meshes.
+
+TPU-native replacement for the reference's nccl-tests-driven HardwareProfiler
+(galvatron/core/profiler/hardware_profiler.py:11-500 and the vendored
+site_package/nccl-tests CUDA binaries). Instead of spawning `mpirun
+all_reduce_perf` per group topology and parsing "Avg bus bandwidth" from logs
+(hardware_profiler.py:422-487), each collective is a jitted `shard_map`
+program over a mesh factored into (outer, inner) axes, timed in-process with
+`block_until_ready`. All groups of a given size run the collective
+simultaneously — the steady-state pattern of hybrid-parallel training, and
+what the cost model's coefficients describe.
+
+Group topology mapping (reference generate_allreduce_groups,
+hardware_profiler.py:380-420): a "consecutive" group of size g is the MINOR
+mesh axis (contiguous ICI neighbours on a real slice); "non-consecutive" is
+the MAJOR axis (strided ranks — DCN-crossing on multi-host). This mirrors
+parallel/mesh.py's tp_consec axis assignment.
+
+Outputs (same JSON schemas the search engine reads,
+search/engine.py:set_hardware_profiles):
+- allreduce_bandwidth_*.json  {"allreduce_size_%d_consec_%d": GB/s busbw}
+- p2p_bandwidth_*.json        {"pp_size_%d": GB/s}
+- sp_time_*.json              {"allreduce"|"all2all": {deg: {"popt": [ms/MB, ms]}}}
+- overlap_coefficient.json    {"overlap_coe": slowdown when comm overlaps compute}
+
+Bus-bandwidth conventions follow nccl-tests (so numbers are comparable to the
+reference's): allreduce busbw = 2(g-1)/g * bytes/t; all2all (g-1)/g * bytes/t;
+p2p ring sendrecv bytes/t.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from galvatron_tpu.utils.jsonio import write_json_config
+
+
+@dataclass
+class HardwareProfileArgs:
+    """Reference galvatron_profile_hardware_args (core/profiler/arguments.py:88-180),
+    minus the mpi/hostfile/nccl-test knobs that have no TPU counterpart."""
+
+    start_mb: float = 1.0
+    end_mb: float = 64.0
+    scale: int = 2  # multiplicative step between message sizes
+    warmup: int = 2
+    iters: int = 5
+    avg_or_min_or_first: str = "avg"
+    max_pp_deg: int = 8
+    max_tp_deg: int = 8
+    overlap_time_multiply: int = 4
+    config_dir: str = "configs"
+
+
+def _aggregate(ts: Sequence[float], mode: str) -> float:
+    if mode == "min":
+        return float(np.min(ts))
+    if mode == "first":
+        return float(ts[0])
+    return float(np.mean(ts))
+
+
+def _time_fn(fn: Callable, args: tuple, warmup: int, iters: int, mode: str) -> float:
+    """Wall-time one jitted program (seconds)."""
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return _aggregate(ts, mode)
+
+
+class HardwareProfiler:
+    """Measures ICI/DCN collective performance on the available devices."""
+
+    def __init__(self, args: Optional[HardwareProfileArgs] = None, devices=None):
+        self.args = args or HardwareProfileArgs()
+        self.devices = list(devices) if devices is not None else jax.devices()
+        self.ndev = len(self.devices)
+
+    # ------------------------------------------------------------------ meshes
+    def _group_mesh(self, group_size: int, consec: bool) -> Tuple[Mesh, str]:
+        """Mesh of all devices where `group_size`-rank groups are one axis.
+        consec=True puts the group on the minor axis (contiguous devices)."""
+        outer = self.ndev // group_size
+        if consec:
+            shape, names, group_axis = (outer, group_size), ("outer", "inner"), "inner"
+        else:
+            shape, names, group_axis = (group_size, outer), ("inner", "outer"), "inner"
+        try:
+            from jax.experimental import mesh_utils
+
+            dev_array = mesh_utils.create_device_mesh(shape, devices=self.devices)
+        except Exception:
+            dev_array = np.array(self.devices).reshape(shape)
+        return Mesh(dev_array, names), group_axis
+
+    def _message(self, mesh: Mesh, mb: float, dtype=jnp.float32) -> jax.Array:
+        """Per-device buffer of `mb` MB, distinct data per device so constant
+        folding cannot elide the collective. Global shape (ndev, nelem),
+        sharded one row per device."""
+        nelem = max(int(mb * 2**20) // np.dtype(np.float32).itemsize, 8)
+        axes = mesh.axis_names
+        x = jnp.arange(self.ndev * nelem, dtype=dtype).reshape(self.ndev, nelem) * 1e-9
+        spec = P(axes) if len(axes) == 1 else P(tuple(axes))
+        # flatten mesh axes onto dim 0: one row per device
+        return jax.device_put(x, NamedSharding(mesh, P(tuple(mesh.axis_names))))
+
+    # ------------------------------------------------------------- collectives
+    def _collective_time_ms(self, kind: str, group_size: int, consec: bool, mb: float) -> float:
+        """Time one collective over all size-`group_size` groups at once; the
+        per-rank message is `mb` MB."""
+        if group_size > self.ndev:
+            raise ValueError("group size %d > %d devices" % (group_size, self.ndev))
+        mesh, gax = self._group_mesh(group_size, consec)
+        x = self._message(mesh, mb)
+        all_axes = tuple(mesh.axis_names)
+
+        def body(local):
+            # local: (1, nelem) — this device's message
+            if kind == "allreduce":
+                return jax.lax.psum(local, gax)
+            if kind == "allgather":
+                return jax.lax.all_gather(local, gax, axis=0, tiled=True)
+            if kind == "reducescatter":
+                return jax.lax.psum_scatter(local, gax, scatter_dimension=1, tiled=True)
+            if kind == "all2all":
+                g = group_size
+                nelem = local.shape[1]
+                blk = local.reshape(g, nelem // g)
+                return jax.lax.all_to_all(blk, gax, split_axis=0, concat_axis=0, tiled=False)
+            if kind == "sendrecv":
+                n = group_size
+                perm = [(j, (j + 1) % n) for j in range(n)]
+                return jax.lax.ppermute(local, gax, perm)
+            raise ValueError(kind)
+
+        fn = jax.jit(
+            jax.shard_map(
+                body, mesh=mesh, in_specs=P(all_axes), out_specs=P(all_axes)
+            )
+        )
+        a = self.args
+        return _time_fn(fn, (x,), a.warmup, a.iters, a.avg_or_min_or_first) * 1e3
+
+    @staticmethod
+    def busbw_gbps(kind: str, group_size: int, mb: float, ms: float) -> float:
+        """nccl-tests bus-bandwidth conventions (so results are directly
+        comparable with the reference's hardware_configs JSONs)."""
+        g = group_size
+        factor = {
+            "allreduce": 2.0 * (g - 1) / g,
+            "allgather": (g - 1) / g,
+            "reducescatter": (g - 1) / g,
+            "all2all": (g - 1) / g,
+            "sendrecv": 1.0,
+        }[kind]
+        gb = mb / 1024.0
+        return factor * gb / (ms / 1e3) if ms > 0 else float("inf")
+
+    # ---------------------------------------------------------------- profiles
+    def _group_sizes(self, limit: int) -> List[int]:
+        out, g = [], 2
+        while g <= min(limit, self.ndev):
+            out.append(g)
+            g *= 2
+        return out
+
+    def _sweep_mbs(self) -> List[float]:
+        a, out = self.args, []
+        mb = a.start_mb
+        while mb <= a.end_mb:
+            out.append(mb)
+            mb *= a.scale
+        return out
+
+    def profile_allreduce_bandwidth(self) -> Dict[str, float]:
+        """Bus bandwidth per (group size, consec) at the largest message size
+        (reference parses the avg over its sweep; the large-message busbw is
+        the stable regime both use for the cost-model coefficient)."""
+        mb = self.args.end_mb
+        out: Dict[str, float] = {}
+        for g in self._group_sizes(self.args.max_tp_deg * self.args.max_pp_deg):
+            placements = [True] if g == self.ndev else [True, False]
+            for consec in placements:
+                ms = self._collective_time_ms("allreduce", g, consec, mb)
+                out["allreduce_size_%d_consec_%d" % (g, int(consec))] = round(
+                    self.busbw_gbps("allreduce", g, mb, ms), 3
+                )
+        return out
+
+    def profile_p2p_bandwidth(self) -> Dict[str, float]:
+        """Ring send/recv bandwidth per pipeline degree (reference
+        sendrecv_perf per pp split, hardware_profiler.py:218-249)."""
+        mb = self.args.end_mb
+        out: Dict[str, float] = {}
+        for g in self._group_sizes(self.args.max_pp_deg):
+            # pipeline stages are the MAJOR axis (dp/tp groups inside a stage)
+            ms = self._collective_time_ms("sendrecv", g, False, mb)
+            out["pp_size_%d" % g] = round(self.busbw_gbps("sendrecv", g, mb, ms), 3)
+        return out
+
+    def profile_sp_time(self) -> Dict[str, Dict]:
+        """Per-degree linear fits time(ms) = m * message_MB + c for allreduce
+        and all2all — the tables the SP/Ulysses cost paths interpolate
+        (reference profile_sp_bandwidth, hardware_profiler.py:251-316;
+        consumed by cost_model._table_time)."""
+        fits: Dict[str, Dict] = {"allreduce": {}, "all2all": {}}
+        mbs = self._sweep_mbs()
+        for kind in ("allreduce", "all2all"):
+            for g in self._group_sizes(self.args.max_tp_deg):
+                times = [self._collective_time_ms(kind, g, True, mb) for mb in mbs]
+                if len(mbs) < 2:
+                    m, c = times[0] / mbs[0], 0.0
+                else:
+                    m, c = np.polyfit(np.asarray(mbs, np.float64), np.asarray(times, np.float64), 1)
+                fits[kind][g] = {"popt": [float(max(m, 0.0)), float(max(c, 0.0))]}
+        return fits
+
+    def profile_overlap(self) -> Dict[str, float]:
+        """Compute/communication overlap slowdown coefficient (reference
+        profile_overlap.py: concurrent compute & allreduce streams ->
+        overlap_coe=1.1256 on the authors' cluster). Here: time a matmul
+        chain, an allreduce chain, and one program containing both; XLA/TPU
+        overlaps async collectives with compute, so
+        coe = t_both / max(t_compute, t_comm), clamped to >= 1."""
+        if self.ndev < 2:
+            return {"overlap_coe": 1.0}
+        mesh, gax = self._group_mesh(self.ndev, True)
+        n = 1024
+        k = self.args.overlap_time_multiply
+        w = jnp.eye(n, dtype=jnp.bfloat16) * 1.0001
+        x = self._message(mesh, self.args.end_mb)
+        all_axes = tuple(mesh.axis_names)
+
+        def compute(w):
+            y = w
+            for _ in range(8 * k):
+                y = (y @ w)
+            return y
+
+        def comm_body(local):
+            y = local
+            for _ in range(k):
+                y = jax.lax.psum(y, gax)
+            return y
+
+        comm = jax.jit(jax.shard_map(comm_body, mesh=mesh, in_specs=P(all_axes), out_specs=P(all_axes)))
+
+        def both_body(w, local):
+            return compute(w), comm_body(local)
+
+        both = jax.jit(
+            jax.shard_map(
+                both_body, mesh=mesh, in_specs=(P(None, None), P(all_axes)),
+                out_specs=(P(None, None), P(all_axes)),
+            )
+        )
+        a = self.args
+        t_comp = _time_fn(jax.jit(compute), (w,), a.warmup, a.iters, a.avg_or_min_or_first)
+        t_comm = _time_fn(comm, (x,), a.warmup, a.iters, a.avg_or_min_or_first)
+        t_both = _time_fn(both, (w, x), a.warmup, a.iters, a.avg_or_min_or_first)
+        coe = t_both / max(max(t_comp, t_comm), 1e-9)
+        return {"overlap_coe": round(float(np.clip(coe, 1.0, 2.0)), 4)}
+
+    # ------------------------------------------------------------------- files
+    def config_paths(self) -> Dict[str, str]:
+        d = self.args.config_dir
+        tag = "%dchips" % self.ndev
+        return {
+            "allreduce": os.path.join(d, "allreduce_bandwidth_%s.json" % tag),
+            "p2p": os.path.join(d, "p2p_bandwidth_%s.json" % tag),
+            "sp": os.path.join(d, "sp_time_%s.json" % tag),
+            "overlap": os.path.join(d, "overlap_coefficient.json"),
+        }
+
+    def profile_all(self, write: bool = True) -> Dict[str, Dict]:
+        """The reference profile_hardware.py:5-16 pipeline: bandwidth ->
+        sp tables -> overlap."""
+        results = {
+            "allreduce": self.profile_allreduce_bandwidth(),
+            "p2p": self.profile_p2p_bandwidth(),
+            "sp": self.profile_sp_time(),
+            "overlap": self.profile_overlap(),
+        }
+        if write:
+            paths = self.config_paths()
+            os.makedirs(self.args.config_dir, exist_ok=True)
+            for key, data in results.items():
+                write_json_config(data, paths[key])
+        return results

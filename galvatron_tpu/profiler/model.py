@@ -1,0 +1,302 @@
+"""Model profiler: per-layer time/memory via layer differencing.
+
+TPU-native replacement for the reference ModelProfiler
+(galvatron/core/profiler/model_profiler.py:14-1051). The reference launches
+the model's own train_dist as subprocesses with varied layer counts via
+`os.system` (:181-299) and post-processes the JSONs those runs write; here the
+same layer-differencing methodology (:328-372) runs IN-PROCESS:
+
+    per-layer quantity = (Q(layernum_max) - Q(layernum_min))
+                         / (layernum_max - layernum_min) / batch_size
+
+- time: jitted forward over an n-layer stack, walltimed with
+  `block_until_ready` (the CUDA-event timing of runtime_profiler.py:189-300
+  has no TPU analogue; dispatch overhead cancels in the difference);
+- memory: XLA's compiled `memory_analysis()` (argument/output/temp bytes) of
+  the forward+backward program — exact compiler-reported HBM, not a runtime
+  sample, so it needs no accelerator to be present.
+
+Per-tp activation entries: the tp=1 (and remat) numbers are MEASURED; tp=k
+entries are act/k because under Megatron-SP every saved activation is
+seq-sharded across the tp group (a measured identity on TPU, where no
+unsharded LayerNorm copies exist — the reason the reference must measure
+per-tp is its partially-replicated SP activations). The vocab ("other")
+tables divide by vtp the same way.
+
+Outputs match search/engine.py:set_model_profiles:
+  computation_profiling_*.json {"layertype_%d": ms|[m,c], "other_time": ms}
+  memory_profiling_*.json      {"layertype_%d": {"parameter_size": MB,
+     "tp_activation_per_bsz_dict": {tp: MB, "checkpoint": MB}},
+     "other_memory_pp_off"/"other_memory_pp_on": {...}}
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from dataclasses import dataclass
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from galvatron_tpu.models import base as M
+from galvatron_tpu.utils.jsonio import write_json_config
+
+MB = 2.0**20
+
+
+@dataclass
+class ModelProfileArgs:
+    """Reference galvatron_profile_args (core/profiler/arguments.py:1-86)."""
+
+    profile_type: str = "computation"  # computation | memory
+    profile_mode: str = "static"  # static | batch | sequence
+    profile_batch_size: int = 8
+    profile_min_batch_size: int = 1
+    profile_max_batch_size: int = 8
+    batch_size_step: int = 1
+    profile_seq_length: Optional[int] = None  # default: cfg.max_seq_len
+    profile_min_seq_length: int = 512
+    profile_max_seq_length: int = 2048
+    seq_length_step: int = 512
+    layernum_min: int = 1
+    layernum_max: int = 3
+    warmup: int = 2
+    iters: int = 5
+    max_tp_deg: int = 8
+    mixed_precision: str = "bf16"
+    config_dir: str = "configs"
+
+
+def _tree_bytes(tree) -> int:
+    return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(tree))
+
+
+def _walltime(fn, args, warmup, iters) -> float:
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return float(np.mean(ts))
+
+
+def _compiled_peak_bytes(fn, args) -> float:
+    """Compiler-reported working set of one jitted call: temps + outputs
+    (+ arguments are counted by the caller where relevant)."""
+    stats = jax.jit(fn).lower(*args).compile().memory_analysis()
+    if stats is None:
+        return 0.0
+    return float(stats.temp_size_in_bytes + stats.output_size_in_bytes)
+
+
+class ModelProfiler:
+    """Profiles one model family (a TransformerConfig); multi-layer-type
+    models (T5) profile each layer type with its own config/profiler."""
+
+    def __init__(self, cfg: M.TransformerConfig, model_name: str = "model",
+                 args: Optional[ModelProfileArgs] = None):
+        self.cfg = cfg
+        self.model_name = model_name
+        self.args = args or ModelProfileArgs()
+
+    # ------------------------------------------------------------- primitives
+    def _stack(self, n_layers: int, bsz: int, seq: int, remat: bool = False):
+        """Jitted forward over an n-layer stack (no embed/head) + its inputs."""
+        cfg = dataclasses.replace(self.cfg, num_layers=max(n_layers, 1))
+        dtype = jnp.bfloat16 if self.args.mixed_precision == "bf16" else jnp.float32
+        keys = jax.random.split(jax.random.PRNGKey(0), max(n_layers, 1))
+        layers = [M.init_layer_params(k, cfg) for k in keys[:n_layers]]
+        x = jax.random.normal(jax.random.PRNGKey(1), (bsz, seq, cfg.hidden_size), dtype)
+        positions = jnp.broadcast_to(jnp.arange(seq), (bsz, seq))
+
+        def fwd(layers, x):
+            body = partial(M.layer_forward, cfg=cfg)
+            for lp in layers:
+                f = jax.checkpoint(body) if remat else body
+                x = f(lp, x, positions)
+            return jnp.sum(x.astype(jnp.float32))
+
+        return fwd, layers, x
+
+    def _full_model(self, n_layers: int, bsz: int, seq: int):
+        cfg = dataclasses.replace(self.cfg, num_layers=max(n_layers, 1), max_seq_len=max(seq, self.cfg.max_seq_len))
+        params = M.init_model_params(jax.random.PRNGKey(0), cfg)
+        params["layers"] = params["layers"][:n_layers]
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (bsz, seq), 0, cfg.vocab_size)
+        batch = {
+            "tokens": tokens,
+            "positions": jnp.broadcast_to(jnp.arange(seq), (bsz, seq)),
+            "labels": jnp.roll(tokens, -1, 1),
+        }
+
+        def loss(params, batch):
+            x = M.embed_tokens(params["embed"], batch["tokens"], batch["positions"], cfg)
+            for lp in params["layers"]:
+                x = M.layer_forward(lp, x, batch["positions"], cfg)
+            logits = M.lm_logits(params, x, cfg)
+            return M.vocab_parallel_cross_entropy(logits, batch["labels"])
+
+        return loss, params, batch
+
+    # ------------------------------------------------------------ computation
+    def _fwd_ms_per_layer_per_sample(self, bsz: int, seq: int) -> float:
+        a = self.args
+        lo, hi = a.layernum_min, a.layernum_max
+        f_lo, l_lo, x = self._stack(lo, bsz, seq)
+        t_lo = _walltime(jax.jit(f_lo), (l_lo, x), a.warmup, a.iters)
+        f_hi, l_hi, x = self._stack(hi, bsz, seq)
+        t_hi = _walltime(jax.jit(f_hi), (l_hi, x), a.warmup, a.iters)
+        return max((t_hi - t_lo) / (hi - lo) / bsz * 1e3, 1e-6)
+
+    def _other_ms_per_sample(self, bsz: int, seq: int, per_layer_ms: float) -> float:
+        """Embedding + head + loss time: full tiny model minus its layers'
+        share (reference separates this as 'other_time')."""
+        a = self.args
+        loss, params, batch = self._full_model(a.layernum_min, bsz, seq)
+        t = _walltime(jax.jit(loss), (params, batch), a.warmup, a.iters)
+        return max(t / bsz * 1e3 - a.layernum_min * per_layer_ms, 1e-6)
+
+    def profile_computation(self) -> Dict:
+        """time_config for the search engine. profile_mode:
+        - static: one scalar at (profile_batch_size, seq);
+        - batch: linear fit [m, c] of per-layer total ms vs batch size
+          (reference fits with scipy at search time, search_engine.py:119-163
+          — here the fit happens at profile time, same curve);
+        - sequence: quadratic sweep over seq; stored under "seqlen%d" keys plus
+          the fit evaluated at the target seq as the headline scalar."""
+        a = self.args
+        seq = a.profile_seq_length or self.cfg.max_seq_len
+        out: Dict = {}
+        if a.profile_mode == "batch":
+            bszs = list(range(a.profile_min_batch_size, a.profile_max_batch_size + 1, a.batch_size_step))
+            totals = [self._fwd_ms_per_layer_per_sample(b, seq) * b for b in bszs]
+            m, c = np.polyfit(np.asarray(bszs, np.float64), np.asarray(totals, np.float64), 1)
+            # time is monotone in batch; clamp fit noise so a noisy sweep can
+            # never feed the search a negative marginal cost
+            out["layertype_0"] = [float(max(m, 0.0)), float(max(c, 0.0))]
+            per_layer_ref = totals[-1] / bszs[-1]
+            out["other_time"] = self._other_ms_per_sample(bszs[-1], seq, per_layer_ref)
+        elif a.profile_mode == "sequence":
+            seqs = list(range(a.profile_min_seq_length, a.profile_max_seq_length + 1, a.seq_length_step))
+            per_seq = {s: self._fwd_ms_per_layer_per_sample(a.profile_batch_size, s) for s in seqs}
+            for s, v in per_seq.items():
+                out["layertype_0_seqlen%d" % s] = v
+            coef = np.polyfit(np.asarray(seqs, np.float64), np.asarray(list(per_seq.values())), 2)
+            out["layertype_0_seq_popt"] = [float(v) for v in coef]
+            out["layertype_0"] = float(np.polyval(coef, seq))
+            out["other_time"] = self._other_ms_per_sample(a.profile_batch_size, seq, out["layertype_0"])
+        else:
+            per_layer = self._fwd_ms_per_layer_per_sample(a.profile_batch_size, seq)
+            out["layertype_0"] = per_layer
+            out["other_time"] = self._other_ms_per_sample(a.profile_batch_size, seq, per_layer)
+        return out
+
+    # ----------------------------------------------------------------- memory
+    def _act_bytes_per_sample(self, bsz: int, seq: int, remat: bool) -> float:
+        """Layer-differenced fwd+bwd working set per layer per sample."""
+        a = self.args
+        lo, hi = a.layernum_min, a.layernum_max
+
+        def grad_prog(n):
+            fwd, layers, x = self._stack(n, bsz, seq, remat=remat)
+            g = lambda layers, x: jax.grad(fwd)(layers, x)
+            return g, (layers, x)
+
+        g_lo, args_lo = grad_prog(lo)
+        g_hi, args_hi = grad_prog(hi)
+        b_lo = _compiled_peak_bytes(g_lo, args_lo)
+        b_hi = _compiled_peak_bytes(g_hi, args_hi)
+        # subtract the grad outputs (they equal the extra layers' param bytes
+        # and are model-state, not activation, memory)
+        extra_params = _tree_bytes(args_hi[0]) - _tree_bytes(args_lo[0])
+        per_layer = (b_hi - b_lo - 2 * extra_params) / (hi - lo)
+        return max(per_layer / bsz, 1024.0)
+
+    def _vocab_tables(self, bsz: int, seq: int, tps: Sequence[int]):
+        """'Other' (embed/cls) model-state and activation tables per vtp.
+        model_states = 4x params (param+grad+adam moments, fp32 master), the
+        same convention MemoryCostModel applies to layer parameter_size."""
+        loss, params, batch = self._full_model(0, bsz, seq)
+        embed_mb = _tree_bytes(params["embed"]) / MB
+        head_mb = embed_mb if self.cfg.tie_embeddings else _tree_bytes(params.get("lm_head", {})) / MB
+        norm_mb = _tree_bytes(params["final_norm"]) / MB
+        act_total = _compiled_peak_bytes(lambda p, b: jax.grad(loss)(p, b), (params, batch))
+        act_total = max(act_total - 2 * _tree_bytes(params), 1024.0) / MB
+
+        def per_tp(x):
+            return {t: round(x / t, 3) for t in tps}
+
+        off = {
+            "model_states": per_tp(4 * (embed_mb + head_mb + norm_mb)),
+            "activation": {t: round(act_total / bsz / t, 3) for t in tps},
+        }
+        on = {
+            "first_stage": {
+                "model_states": per_tp(4 * embed_mb),
+                "activation": {t: round(0.5 * act_total / bsz / t, 3) for t in tps},
+            },
+            "last_stage": {
+                "model_states": per_tp(4 * (head_mb + norm_mb)),
+                "activation": {t: round(0.5 * act_total / bsz / t, 3) for t in tps},
+            },
+        }
+        return off, on
+
+    def profile_memory(self) -> Dict:
+        a = self.args
+        seq = a.profile_seq_length or self.cfg.max_seq_len
+        bsz = a.profile_batch_size
+        tps = []
+        t = 1
+        while t <= a.max_tp_deg:
+            tps.append(t)
+            t *= 2
+        param_mb = _tree_bytes(M.init_layer_params(jax.random.PRNGKey(0), self.cfg)) / MB
+        act1 = self._act_bytes_per_sample(bsz, seq, remat=False) / MB
+        act_ckpt = self._act_bytes_per_sample(bsz, seq, remat=True) / MB
+        tp_act = {t: round(act1 / t, 3) for t in tps}
+        tp_act["checkpoint"] = round(min(act_ckpt, act1), 3)
+        other_off, other_on = self._vocab_tables(bsz, seq, tps)
+        return {
+            "layertype_0": {
+                "parameter_size": round(param_mb, 3),
+                "tp_activation_per_bsz_dict": tp_act,
+            },
+            "other_memory_pp_off": other_off,
+            "other_memory_pp_on": other_on,
+        }
+
+    # ------------------------------------------------------------------- files
+    def config_paths(self) -> Dict[str, str]:
+        prec = self.args.mixed_precision
+        c = self.cfg
+        seq = self.args.profile_seq_length or c.max_seq_len
+        tag = "%s_hidden%d_head%d_seqlen%d" % (prec, c.hidden_size, c.num_heads, seq)
+        return {
+            "computation": os.path.join(
+                self.args.config_dir, "computation_profiling_%s_%s.json" % (tag, self.model_name)
+            ),
+            "memory": os.path.join(
+                self.args.config_dir, "memory_profiling_%s_%s.json" % (tag, self.model_name)
+            ),
+        }
+
+    def profile_all(self, write: bool = True) -> Dict[str, Dict]:
+        results = {
+            "computation": self.profile_computation(),
+            "memory": self.profile_memory(),
+        }
+        if write:
+            os.makedirs(self.args.config_dir, exist_ok=True)
+            paths = self.config_paths()
+            for k, v in results.items():
+                write_json_config(v, paths[k])
+        return results

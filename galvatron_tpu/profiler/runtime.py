@@ -1,0 +1,144 @@
+"""Runtime (in-training) profiler: iteration timing, throughput, memory.
+
+TPU-native counterpart of the reference RuntimeProfiler
+(galvatron/core/profiler/runtime_profiler.py:10-339): CUDA-event timing with a
+warmup window (:189-300) becomes `block_until_ready` walltime around the
+jitted train step (one step = one XLA program, so walltime IS device time
+after the first dispatch); stage-tagged peak-memory snapshots via
+`torch.cuda.max_memory_allocated` (:99-126) become `device.memory_stats()`
+(live TPU HBM: bytes_in_use / peak_bytes_in_use) plus the compiler-reported
+working set of the compiled step, which is the number the search engine's
+memory constraint is checked against.
+
+Results persist into the same JSON files the search engine reads
+(reference profiler/utils.py save_profiled_time:57 / save_profiled_memory:22).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax
+
+from galvatron_tpu.utils.jsonio import read_json_config, write_json_config
+
+
+def device_memory_stats(device=None) -> Dict[str, float]:
+    """Current/peak HBM bytes for one device; zeros when the backend does not
+    report (CPU test meshes)."""
+    device = device or jax.local_devices()[0]
+    try:
+        stats = device.memory_stats() or {}
+    except Exception:
+        stats = {}
+    return {
+        "bytes_in_use": float(stats.get("bytes_in_use", 0.0)),
+        "peak_bytes_in_use": float(stats.get("peak_bytes_in_use", stats.get("bytes_in_use", 0.0))),
+        "bytes_limit": float(stats.get("bytes_limit", 0.0)),
+    }
+
+
+def compiled_step_memory_mb(compiled) -> float:
+    """HBM working set of a compiled train step (args + temps + outputs),
+    the quantity MemoryCostModel predicts."""
+    stats = compiled.memory_analysis()
+    if stats is None:
+        return 0.0
+    total = (
+        stats.temp_size_in_bytes
+        + stats.argument_size_in_bytes
+        + stats.output_size_in_bytes
+        - getattr(stats, "alias_size_in_bytes", 0)
+    )
+    return float(total) / 2**20
+
+
+@dataclass
+class RuntimeProfiler:
+    """Wrap a train loop: `start(it)` / `end(it, n_samples)` around each step.
+
+    Iterations inside the warmup window are timed but excluded from the
+    summary (reference profile_time_start/end warmup handling,
+    runtime_profiler.py:189-300)."""
+
+    warmup: int = 2
+    rank: int = 0
+    save_path: Optional[str] = None
+    model_name: str = "model"
+    _t0: float = 0.0
+    iter_times_ms: List[float] = field(default_factory=list)
+    all_times_ms: List[float] = field(default_factory=list)
+    samples: List[int] = field(default_factory=list)
+    memory_snapshots: Dict[str, Dict[str, float]] = field(default_factory=dict)
+    _iter: int = 0
+
+    # ------------------------------------------------------------------ timing
+    def start(self, iteration: int):
+        self._iter = iteration
+        self._t0 = time.perf_counter()
+
+    def end(self, iteration: int, n_samples: int = 0, outputs=None):
+        """Call with the step outputs so the timer blocks until the device
+        finishes (outputs=None times dispatch only)."""
+        if outputs is not None:
+            jax.block_until_ready(outputs)
+        dt = (time.perf_counter() - self._t0) * 1e3
+        self.all_times_ms.append(dt)
+        if iteration >= self.warmup:
+            self.iter_times_ms.append(dt)
+            self.samples.append(n_samples)
+        return dt
+
+    # ------------------------------------------------------------------ memory
+    def profile_memory(self, iteration: int, stage: str = ""):
+        """Stage-tagged snapshot (reference profile_memory/post_profile_memory,
+        runtime_profiler.py:99-128)."""
+        key = "iter_%d_%s" % (iteration, stage or "snap")
+        self.memory_snapshots[key] = device_memory_stats()
+        return self.memory_snapshots[key]
+
+    # ----------------------------------------------------------------- summary
+    def summary(self) -> Dict[str, float]:
+        if not self.iter_times_ms:
+            return {"avg_iter_ms": 0.0, "samples_per_s": 0.0, "iters": 0}
+        avg = float(np.mean(self.iter_times_ms))
+        tput = (
+            float(np.sum(self.samples)) / (float(np.sum(self.iter_times_ms)) / 1e3)
+            if np.sum(self.iter_times_ms) > 0
+            else 0.0
+        )
+        peak = max((m["peak_bytes_in_use"] for m in self.memory_snapshots.values()), default=0.0)
+        return {
+            "avg_iter_ms": avg,
+            "p50_iter_ms": float(np.percentile(self.iter_times_ms, 50)),
+            "samples_per_s": tput,
+            "peak_hbm_mb": peak / 2**20,
+            "iters": len(self.iter_times_ms),
+        }
+
+    def log_iteration(self, iteration: int, metrics: Optional[dict] = None, print_fn=print):
+        """reference _log_iteration_stats (runtime_profiler.py:303)."""
+        if self.rank != 0 or not self.all_times_ms:
+            return
+        extra = ""
+        if metrics:
+            extra = " " + " ".join(
+                "%s=%.4g" % (k, float(v)) for k, v in metrics.items() if np.isscalar(v) or getattr(v, "ndim", 1) == 0
+            )
+        print_fn("iter %4d | %8.2f ms%s" % (iteration, self.all_times_ms[-1], extra))
+
+    # -------------------------------------------------------------------- save
+    def save(self, path: Optional[str] = None):
+        """Merge this run's summary into a profiling JSON keyed by model
+        (reference profiler/utils.py:22-90 merges into shared config files)."""
+        path = path or self.save_path
+        if not path:
+            return
+        existing = read_json_config(path) if os.path.exists(path) else {}
+        existing[self.model_name] = self.summary()
+        write_json_config(existing, path)

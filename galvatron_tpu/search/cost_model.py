@@ -266,7 +266,10 @@ class TimeCostModel:
         self.bsz = global_batch_size / self.dp_size
 
         # ---- compute ------------------------------------------------------
-        per_shard_bsz = self.bsz / (self.tp_size if not self.ulysses else 1) / self.cp_size
+        # both megatron-tp and ulysses shard per-device compute tp-fold
+        # (ulysses shards the sequence, tp the heads/ffn); cp shards the
+        # sequence cp-fold
+        per_shard_bsz = self.bsz / self.tp_size / self.cp_size
         self.fct = _eval_fit(pma.forward_computation_time, per_shard_bsz) * self.layer_num
         self.bct = self.fct * pha.bct_fct_coe
         if self.checkpoint:

@@ -243,6 +243,18 @@ class DpOnModel:
         self.pp_stage_dict = pp_stage_dict or {}
         self.comm_coe_dict = comm_coe_dict or {}
         self.gpu_num = gpu_num
+        # inter-layer resharding coefficient: measured allreduce ms/MB at the
+        # widest profiled group (comm_coe handles the 'N'/'N_0'/'N_1' key
+        # styles); 0.01 only when no hardware profile was supplied at all
+        self._reshard_coe = 0.01
+        from galvatron_tpu.search.cost_model import comm_coe
+
+        for deg in [gpu_num] + [2**k for k in range(10, 0, -1)]:
+            try:
+                self._reshard_coe = comm_coe(self.comm_coe_dict, deg, consec=True)
+                break
+            except KeyError:
+                continue
         self.mem_cache_mb = mem_cache_mb
         self.fine_grained_mode = fine_grained_mode
         self.use_cpp_core = use_cpp_core
@@ -276,8 +288,7 @@ class DpOnModel:
                 if moved == 0.0 and (si[1] != sj[1]):
                     # pure tp-degree change still permutes hidden shards
                     moved = act_mb_full * (1.0 / di) * 0.5
-                coe = self.comm_coe_dict.get("%d" % self.gpu_num, 0.01)
-                cost[i, j] = moved * coe
+                cost[i, j] = moved * self._reshard_coe
         # tiny tie-break bias keeps deterministic ordering of equivalent
         # sp/fsdp/ckpt variants (reference dynamic_programming.py:355-366)
         for j, sj in enumerate(strategies):

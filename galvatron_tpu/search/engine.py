@@ -11,8 +11,6 @@ property; SURVEY.md §4)."""
 
 from __future__ import annotations
 
-import copy
-import math
 import os
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
@@ -33,7 +31,6 @@ from galvatron_tpu.search.cost_model_args import (
     TrainArgs,
 )
 from galvatron_tpu.search.dynamic_programming import DpOnModel
-from galvatron_tpu.utils.jsonio import read_json_config, write_json_config
 from galvatron_tpu.utils.strategy_utils import form_strategy
 
 
@@ -103,10 +100,14 @@ def generate_strategies(world_size: int, args: SearchArgs) -> List[list]:
                     continue
                 if args.disable_dp and dp > 1:
                     continue
-                if space == "tp" and dp > 1:
+                if space in ("tp", "pp") and dp > 1:
                     continue
                 base_infos: List[dict] = [{}]
                 # tp consecutive placement choice (minor vs major ICI axes)
+                if space == "3d":
+                    # plain pp x tp x dp grid: no placement/sp/zero/ckpt variants
+                    strategies.append([pp, tp, dp, {"tp": 1} if tp > 1 else {}])
+                    continue
                 if tp > 1 and dp > 1 and not args.disable_tp_consec:
                     base_infos = [{"tp": 1}, {"tp": 0}]
                 elif tp > 1:
@@ -318,13 +319,22 @@ class GalvatronSearchEngine:
                              vsp: int = 0, embed_sdp: bool = False):
         bundles = self._bundles(chunks)
         ma_list, ta_list, pa_list, pma_list, pha_list = bundles
+        # a strategy is only feasible at this bsz if every dp rank gets a
+        # whole (micro)batch — otherwise the runtime config rejects it
+        # (HybridParallelConfig.validate global_bsz % dp)
+        feasible = [s for s in self.strategies if s[2] <= bsz and bsz % s[2] == 0]
+        if not feasible:
+            return dict(cost=float("inf"), strategies=None, remaining=0, vtp=1,
+                        pp=1, bsz=bsz, chunks=chunks, vsp=vsp, embed_sdp=embed_sdp,
+                        pp_division=None)
         dpom = DpOnModel(
-            self.strategies,
+            feasible,
             MemoryCostModel,
             TimeCostModel,
             OtherTimeCostModel,
             ma_list, ta_list, pa_list, pma_list, pha_list,
             max_mem=int(self.args.memory_constraint * 1024),
+            use_pipeline_costmodel=self.args.use_pipeline_costmodel,
             layer_nums=[lc["layer_num"] for lc in self.layer_configs],
             multi_layer_type=self.num_layertype > 1,
             pp_stage_dict=self._pp_stage_dict(bundles),

@@ -1,0 +1,82 @@
+"""Hardware profiler tests on the 8-virtual-device CPU mesh.
+
+Latencies on a CPU backend are meaningless as bandwidths; these tests verify
+group construction, schema, and that the outputs feed the search engine
+(reference tests/profiler/ against temp config dirs, SURVEY.md §4)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from galvatron_tpu.profiler.hardware import HardwareProfiler, HardwareProfileArgs
+from galvatron_tpu.utils.jsonio import read_json_config
+
+
+@pytest.fixture(scope="module")
+def profiler(devices8):
+    args = HardwareProfileArgs(start_mb=0.25, end_mb=0.5, warmup=1, iters=2)
+    return HardwareProfiler(args, devices=devices8)
+
+
+def test_allreduce_bandwidth_schema(profiler):
+    bw = profiler.profile_allreduce_bandwidth()
+    # sizes 2/4 have consec 0 and 1; full-world size 8 only consec 1
+    assert set(bw) == {
+        "allreduce_size_2_consec_1", "allreduce_size_2_consec_0",
+        "allreduce_size_4_consec_1", "allreduce_size_4_consec_0",
+        "allreduce_size_8_consec_1",
+    }
+    assert all(v > 0 for v in bw.values())
+
+
+def test_p2p_bandwidth_schema(profiler):
+    bw = profiler.profile_p2p_bandwidth()
+    assert set(bw) == {"pp_size_2", "pp_size_4", "pp_size_8"}
+    assert all(v > 0 for v in bw.values())
+
+
+def test_sp_time_fits(profiler):
+    sp = profiler.profile_sp_time()
+    assert set(sp) == {"allreduce", "all2all"}
+    for table in sp.values():
+        for deg, entry in table.items():
+            m, c = entry["popt"]
+            assert m >= 0 and c >= 0
+
+
+def test_collectives_are_correct(devices8):
+    """The timed programs must compute real collectives (guards against XLA
+    constant-folding the measurement away)."""
+    prof = HardwareProfiler(HardwareProfileArgs(start_mb=0.25), devices=devices8)
+    mesh, gax = prof._group_mesh(4, True)
+    x = prof._message(mesh, 0.25)
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    fn = jax.jit(
+        jax.shard_map(
+            lambda l: jax.lax.psum(l, gax), mesh=mesh,
+            in_specs=P(tuple(mesh.axis_names)), out_specs=P(tuple(mesh.axis_names)),
+        )
+    )
+    out = np.asarray(fn(x))
+    ref = np.asarray(x).reshape(2, 4, -1)
+    expect = ref.sum(axis=1, keepdims=True).repeat(4, axis=1).reshape(out.shape)
+    np.testing.assert_allclose(out, expect, rtol=1e-5)
+
+
+def test_overlap_coe_bounds(profiler):
+    coe = profiler.profile_overlap()["overlap_coe"]
+    assert 1.0 <= coe <= 2.0
+
+
+def test_profile_all_writes_files(devices8, tmp_path):
+    args = HardwareProfileArgs(start_mb=0.25, end_mb=0.25, warmup=0, iters=1,
+                               config_dir=str(tmp_path))
+    prof = HardwareProfiler(args, devices=devices8)
+    results = prof.profile_all(write=True)
+    for key, path in prof.config_paths().items():
+        assert os.path.exists(path), key
+        assert read_json_config(path)
+    assert results["overlap"]["overlap_coe"] >= 1.0

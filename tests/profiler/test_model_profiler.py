@@ -1,0 +1,121 @@
+"""Model profiler: layer differencing + schema + end-to-end feed into search.
+
+The end-to-end test is the TPU analogue of the reference's full
+profile -> search loop (SURVEY.md §3.5 + §3.3) with a tiny model."""
+
+import jax.numpy as jnp
+import pytest
+
+from galvatron_tpu.models.base import TransformerConfig
+from galvatron_tpu.profiler.model import ModelProfiler, ModelProfileArgs
+from galvatron_tpu.profiler.runtime import RuntimeProfiler
+
+
+def tiny_cfg(**kw):
+    kw.setdefault("hidden_size", 64)
+    kw.setdefault("num_heads", 4)
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("vocab_size", 128)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("compute_dtype", jnp.float32)
+    return TransformerConfig(**kw)
+
+
+@pytest.fixture(scope="module")
+def profiled():
+    args = ModelProfileArgs(
+        profile_batch_size=2, layernum_min=1, layernum_max=2, warmup=1, iters=2,
+        profile_seq_length=64, max_tp_deg=4, mixed_precision="fp32",
+    )
+    prof = ModelProfiler(tiny_cfg(), "tiny", args)
+    return prof.profile_all(write=False)
+
+
+def test_computation_schema(profiled):
+    t = profiled["computation"]
+    assert t["layertype_0"] > 0
+    assert t["other_time"] > 0
+
+
+def test_memory_schema(profiled):
+    m = profiled["memory"]
+    lt = m["layertype_0"]
+    assert lt["parameter_size"] > 0
+    act = lt["tp_activation_per_bsz_dict"]
+    assert act[1] > 0 and act["checkpoint"] <= act[1]
+    # sp sharding law: tp=2 holds half of tp=1
+    assert abs(act[2] - act[1] / 2) < 1e-6
+    for key in ("other_memory_pp_off", "other_memory_pp_on"):
+        assert key in m
+    off = m["other_memory_pp_off"]
+    assert off["model_states"][1] > 0 and off["activation"][1] > 0
+    on = m["other_memory_pp_on"]
+    assert on["first_stage"]["model_states"][1] > 0
+    assert on["last_stage"]["model_states"][1] > 0
+
+
+def test_batch_mode_fit():
+    args = ModelProfileArgs(
+        profile_mode="batch", profile_min_batch_size=1, profile_max_batch_size=3,
+        batch_size_step=1, layernum_min=1, layernum_max=2, warmup=0, iters=1,
+        profile_seq_length=64, mixed_precision="fp32",
+    )
+    t = ModelProfiler(tiny_cfg(), "tiny", args).profile_computation()
+    m, c = t["layertype_0"]
+    assert m >= 0  # time grows with batch
+
+
+def test_profile_to_search_end_to_end(devices8):
+    """Profiled tables must drive a real search to a valid strategy."""
+    from galvatron_tpu.profiler.hardware import HardwareProfiler, HardwareProfileArgs
+    from galvatron_tpu.search.engine import GalvatronSearchEngine, SearchArgs
+
+    cfg = tiny_cfg()
+    margs = ModelProfileArgs(
+        profile_batch_size=2, layernum_min=1, layernum_max=2, warmup=0, iters=1,
+        profile_seq_length=64, max_tp_deg=4, mixed_precision="fp32",
+    )
+    model_results = ModelProfiler(cfg, "tiny", margs).profile_all(write=False)
+    hargs = HardwareProfileArgs(start_mb=0.25, end_mb=0.25, warmup=0, iters=1, max_tp_deg=4)
+    hw = HardwareProfiler(hargs, devices=devices8).profile_all(write=False)
+
+    eng = GalvatronSearchEngine(
+        SearchArgs(memory_constraint=64.0, settle_bsz=8, settle_chunk=1, max_tp_deg=4),
+        world_size=8,
+        model_layer_configs=[{"hidden_size": cfg.hidden_size, "seq_len": 64,
+                              "layer_num": cfg.num_layers}],
+        model_name="tiny",
+    )
+    eng.set_model_profiles(model_results["computation"], model_results["memory"])
+    eng.set_hardware_profiles(hw["allreduce"], hw["p2p"], hw["overlap"], hw["sp"])
+    eng.initialize_search_engine()
+    best = eng.parallelism_optimization()
+    assert best is not None and best["strategies"] is not None
+    hp = eng.result_to_config(best)
+    assert hp.world_size == 8 and hp.num_layers == cfg.num_layers
+
+
+def test_runtime_profiler_summary():
+    import numpy as np
+
+    rp = RuntimeProfiler(warmup=1)
+    for it in range(4):
+        rp.start(it)
+        x = np.ones(4).sum()
+        rp.end(it, n_samples=8)
+        rp.profile_memory(it, "after_step")
+    s = rp.summary()
+    assert s["iters"] == 3
+    assert s["avg_iter_ms"] >= 0
+    assert s["samples_per_s"] > 0
+
+
+def test_runtime_profiler_save(tmp_path):
+    p = str(tmp_path / "runtime.json")
+    rp = RuntimeProfiler(warmup=0, save_path=p, model_name="tiny")
+    rp.start(0)
+    rp.end(0, n_samples=4)
+    rp.save()
+    from galvatron_tpu.utils.jsonio import read_json_config
+
+    assert read_json_config(p)["tiny"]["iters"] == 1

@@ -118,3 +118,62 @@ def test_search_prefers_cheap_comm():
     tps = {s[1] for s in best["strategies"]}
     # roomy memory -> no need for tp=8 everywhere
     assert min(tps) <= 4
+
+
+def test_pp_space_excludes_dp_and_tp():
+    """search_space='pp' must return only pure-pipeline layouts."""
+    s = generate_strategies(8, SearchArgs(search_space="pp"))
+    assert s, "pp space empty"
+    assert all(st[1] == 1 and st[2] == 1 for st in s), s
+
+
+def test_3d_space_is_plain_grid():
+    """'3d' = pp x tp x dp without sp/zero/ckpt/placement variants."""
+    s = generate_strategies(8, SearchArgs(search_space="3d"))
+    assert s
+    for st in s:
+        info = st[3]
+        assert not (set(info) & {"sp", "fsdp", "cpt"}), st
+    # exactly one variant per (pp, tp, dp)
+    keys = [(st[0], st[1], st[2]) for st in s]
+    assert len(keys) == len(set(keys))
+
+
+def test_dp_exceeding_bsz_is_pruned():
+    """dp > bsz (or non-dividing dp) must never be returned as a winner:
+    the runtime config would reject it."""
+    eng = make_engine(mem_gb=64.0, bsz=4, chunk=1)
+    best = eng.parallelism_optimization()
+    assert best is not None
+    for st in best["strategies"]:
+        assert st[2] <= 4 and 4 % st[2] == 0
+    cfg = eng.result_to_config(best)  # validates without raising
+
+
+def test_ulysses_compute_parity_with_tp():
+    """Ulysses shards per-device compute tp-fold just like megatron-tp; the
+    time model must not overcharge sp strategies (they'd never be chosen)."""
+    from galvatron_tpu.search.cost_model import TimeCostModel
+    from galvatron_tpu.search.cost_model_args import (
+        ModelArgs, ParallelArgs, ProfileHardwareArgs, ProfileModelArgs, TrainArgs)
+
+    common = dict(
+        global_batch_size=16,
+        model_args=ModelArgs(parameter_size=96.0, seq_length=2048, hidden_size=4096, layer_num=8),
+        train_args=TrainArgs(mixed_precision=True),
+        parallel_args=ParallelArgs(sp_space="tp+sp"),
+        profile_model_args=ProfileModelArgs(
+            forward_computation_time=5.0,
+            tp_activation_per_bsz_dict=MEMORY_CONFIG["layertype_0"]["tp_activation_per_bsz_dict"],
+            other_memory_pp_off=MEMORY_CONFIG["other_memory_pp_off"],
+            other_memory_pp_on=MEMORY_CONFIG["other_memory_pp_on"],
+            other_time_profiled=2.0),
+        profile_hardware_args=ProfileHardwareArgs(
+            comm_coe_dict={"1": 0.0, "2": 0.008, "4": 0.009, "8": 0.01},
+            allreduce_dict={2: {"popt": [0.01, 0.1]}, 4: {"popt": [0.01, 0.1]}, 8: {"popt": [0.01, 0.1]}},
+            all2all_dict={2: {"popt": [0.005, 0.1]}, 4: {"popt": [0.005, 0.1]}, 8: {"popt": [0.005, 0.1]}}),
+    )
+    t_tp = TimeCostModel([1, 4, 2, {"tp": 1}], **common).gen_result()
+    t_sp = TimeCostModel([1, 4, 2, {"sp": 1}], **common).gen_result()
+    # same compute share; only the collective pattern differs -> within 2x
+    assert t_sp < 2.0 * t_tp
