@@ -1,0 +1,9 @@
+"""Command-line entry points: train / search / profile / profile-hardware.
+
+The analogue of the reference's per-model ``train_dist.py`` / ``search_dist.py``
+/ ``profiler.py`` entry scripts plus ``initialize_galvatron`` (reference
+core/arguments.py:8-30). One set of drivers serves every registered model
+family (``--model_type``), so there is no per-model script duplication.
+"""
+
+from galvatron_tpu.cli.arguments import initialize_galvatron  # noqa: F401

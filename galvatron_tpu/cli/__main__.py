@@ -1,0 +1,36 @@
+"""``python -m galvatron_tpu.cli <subcommand> [flags]``.
+
+Subcommands replace the reference's per-model shell scripts
+(models/*/scripts/train_dist.sh etc.):
+
+    train              run training (GLOBAL flags or --galvatron_config_path)
+    search             run the strategy search (CPU only)
+    profile            profile model computation/memory
+    profile-hardware   profile ICI/DCN collective bandwidths
+"""
+
+import sys
+
+
+def main():
+    if len(sys.argv) < 2 or sys.argv[1] in ("-h", "--help"):
+        print(__doc__)
+        return 0
+    cmd, argv = sys.argv[1], sys.argv[2:]
+    if cmd == "train":
+        from galvatron_tpu.cli.train import main as run
+    elif cmd == "search":
+        from galvatron_tpu.cli.search import main as run
+    elif cmd == "profile":
+        from galvatron_tpu.cli.profile import main_model as run
+    elif cmd == "profile-hardware":
+        from galvatron_tpu.cli.profile import main_hardware as run
+    else:
+        print("unknown subcommand %r\n%s" % (cmd, __doc__))
+        return 2
+    run(argv)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

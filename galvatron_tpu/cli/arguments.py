@@ -1,0 +1,257 @@
+"""Argument system for all execution modes.
+
+Reference: ``initialize_galvatron(model_args, mode)`` with modes
+``train_dist | train | profile | search | profile_hardware`` (core/arguments.py:8-30),
+runtime flags (core/runtime/arguments.py:1-215), search flags
+(core/search_engine/arguments.py:1-146) and profiler flags
+(core/profiler/arguments.py:1-180). Flag names match the reference where the
+concept survives on TPU; NCCL/MPI/apex-specific knobs are dropped and a few
+TPU-only knobs (mesh axis control, pallas toggles) are added.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Callable, Optional, Sequence
+
+MODES = ("train", "train_dist", "search", "profile", "profile_hardware")
+
+
+def _add_model_args(p: argparse.ArgumentParser):
+    g = p.add_argument_group("model")
+    g.add_argument("--model_type", type=str, default="llama", help="model family (see models/registry.py)")
+    g.add_argument("--model_size", type=str, default=None, help="meta-config preset, e.g. llama-7b")
+    g.add_argument("--set_model_config_manually", type=int, default=0)
+    g.add_argument("--set_layernum_manually", type=int, default=0)
+    g.add_argument("--set_seqlen_manually", type=int, default=0)
+    g.add_argument("--hidden_size", type=int, default=None)
+    g.add_argument("--num_attention_heads", type=int, default=None)
+    g.add_argument("--num_kv_heads", type=int, default=None)
+    g.add_argument("--ffn_hidden_size", type=int, default=None)
+    g.add_argument("--num_layers", type=int, default=None)
+    g.add_argument("--seq_length", type=int, default=None)
+    g.add_argument("--vocab_size", type=int, default=None)
+    g.add_argument("--mixed_precision", type=str, default="bf16", choices=("fp32", "bf16"))
+
+
+def _add_parallel_args(p: argparse.ArgumentParser):
+    """GLOBAL-mode strategy flags (reference runtime/arguments.py)."""
+    g = p.add_argument_group("parallel")
+    g.add_argument("--pp_deg", type=int, default=1)
+    g.add_argument("--global_tp_deg", type=int, default=1)
+    g.add_argument("--global_tp_consec", type=int, default=1)
+    g.add_argument("--global_cp_deg", type=int, default=1)
+    g.add_argument("--cp_mode", type=str, default="zigzag", choices=("ring", "zigzag"))
+    g.add_argument("--sdp", type=int, default=0, help="1 => ZeRO-3 on every layer")
+    g.add_argument("--global_train_batch_size", type=int, default=8)
+    g.add_argument("--chunks", type=int, default=1, help="number of microbatches")
+    g.add_argument("--pipeline_type", type=str, default="gpipe", choices=("gpipe", "pipedream_flush"))
+    g.add_argument("--default_dp_type", type=str, default="ddp", choices=("ddp", "zero2", "zero3"))
+    g.add_argument("--embed_sdp", type=int, default=0)
+    g.add_argument("--vocab_tp", type=int, default=1)
+    g.add_argument("--vocab_sp", type=int, default=0)
+    g.add_argument("--vocab_cp", type=int, default=1)
+    g.add_argument("--use-ulysses", dest="use_ulysses", action="store_true",
+                   help="repurpose the tp axis as a Ulysses sequence axis")
+    g.add_argument("--sequence-parallel", dest="sequence_parallel", action="store_true", default=True)
+    g.add_argument("--no-sequence-parallel", dest="sequence_parallel", action="store_false")
+    g.add_argument("--checkpoint", type=int, default=0, help="1 => activation remat on every layer")
+    g.add_argument("--galvatron_config_path", type=str, default=None,
+                   help="searched per-layer strategy JSON; overrides the GLOBAL flags above")
+    g.add_argument("--world_size", type=int, default=None, help="devices to use (default: all)")
+
+
+def _add_train_args(p: argparse.ArgumentParser):
+    g = p.add_argument_group("training")
+    g.add_argument("--train_iters", type=int, default=20)
+    g.add_argument("--lr", type=float, default=1e-4)
+    g.add_argument("--min_lr", type=float, default=1e-5)
+    g.add_argument("--weight_decay", type=float, default=0.01)
+    g.add_argument("--adam_beta1", type=float, default=0.9)
+    g.add_argument("--adam_beta2", type=float, default=0.999)
+    g.add_argument("--adam_eps", type=float, default=1e-8)
+    g.add_argument("--clip_grad", type=float, default=1.0)
+    g.add_argument("--lr_decay_style", type=str, default="cosine", choices=("cosine", "linear", "constant"))
+    g.add_argument("--lr_warmup_iters", type=int, default=0)
+    g.add_argument("--seed", type=int, default=1234)
+    g.add_argument("--data_path", type=str, default=None, help="indexed dataset prefix; default: synthetic data")
+    g.add_argument("--profile", type=int, default=0, help="enable the runtime profiler")
+    g.add_argument("--profile_forward", type=int, default=0)
+    g.add_argument("--save_profiled_memory", type=int, default=0)
+    g.add_argument("--profile_type", type=str, default="computation", choices=("computation", "memory"))
+    g.add_argument("--exit_after_profiling", type=int, default=1)
+    # checkpointing (reference runtime/arguments.py --distributed_checkpoint,
+    # --load_iteration; llama_hf/LlamaModel_checkpoint.py save/load)
+    g.add_argument("--save", type=str, default=None, help="checkpoint output dir")
+    g.add_argument("--load", type=str, default=None, help="checkpoint dir to resume from")
+    g.add_argument("--load_iteration", type=int, default=None)
+    g.add_argument("--save_interval", type=int, default=0, help="0 => only at end")
+    g.add_argument("--distributed_checkpoint", type=int, default=1)
+    g.add_argument("--log_interval", type=int, default=1)
+
+
+def _add_profile_args(p: argparse.ArgumentParser):
+    g = p.add_argument_group("model profiling")
+    g.add_argument("--profile_mode", type=str, default="static", choices=("static", "batch", "sequence"))
+    g.add_argument("--profile_batch_size", type=int, default=8)
+    g.add_argument("--profile_min_batch_size", type=int, default=1)
+    g.add_argument("--profile_max_batch_size", type=int, default=8)
+    g.add_argument("--batch_size_step", type=int, default=1)
+    g.add_argument("--profile_seq_length", type=int, default=None)
+    g.add_argument("--profile_min_seq_length", type=int, default=512)
+    g.add_argument("--profile_max_seq_length", type=int, default=2048)
+    g.add_argument("--seq_length_step", type=int, default=512)
+    g.add_argument("--layernum_min", type=int, default=1)
+    g.add_argument("--layernum_max", type=int, default=2)
+    g.add_argument("--max_tp_deg", type=int, default=8)
+    g.add_argument("--profile_dp_type", type=str, default="zero3")
+
+
+def _add_hardware_args(p: argparse.ArgumentParser):
+    g = p.add_argument_group("hardware profiling")
+    g.add_argument("--start_mb", type=float, default=1.0)
+    g.add_argument("--end_mb", type=float, default=64.0)
+    g.add_argument("--scale", type=int, default=2)
+    g.add_argument("--avg_or_min_or_first", type=str, default="avg", choices=("avg", "min", "first"))
+    g.add_argument("--max_pp_deg", type=int, default=8)
+    g.add_argument("--overlap_time_multiply", type=int, default=4)
+
+
+def _add_search_args(p: argparse.ArgumentParser):
+    g = p.add_argument_group("search")
+    g.add_argument("--memory_constraint", type=float, default=16.0, help="HBM budget per chip, GB")
+    g.add_argument("--search_space", type=str, default="full",
+                   choices=("full", "dp+tp", "dp+pp", "3d", "dp", "sdp", "tp", "pp"))
+    g.add_argument("--sp_space", type=str, default="tp", choices=("tp+sp", "tp", "sp"))
+    for name in ("dp", "tp", "vtp", "pp", "sdp", "ckpt", "tp_consec"):
+        g.add_argument("--disable_%s" % name, type=int, default=0)
+    g.add_argument("--enable_cp", type=int, default=0)
+    g.add_argument("--max_tp_deg_search", dest="search_max_tp_deg", type=int, default=8)
+    g.add_argument("--max_pp_deg_search", dest="search_max_pp_deg", type=int, default=8)
+    g.add_argument("--max_cp_deg", type=int, default=4)
+    g.add_argument("--min_bsz", type=int, default=8)
+    g.add_argument("--max_bsz", type=int, default=None)
+    g.add_argument("--bsz_scale", type=int, default=8)
+    g.add_argument("--settle_bsz", type=int, default=None)
+    g.add_argument("--settle_chunk", type=int, default=None)
+    g.add_argument("--fine_grained_mode", type=int, default=1)
+    g.add_argument("--use_pipeline_costmodel", type=int, default=0)
+    g.add_argument("--time_profile_mode", type=str, default="static", choices=("static", "batch", "sequence"))
+    g.add_argument("--memory_profile_mode", type=str, default="static", choices=("static", "batch", "sequence"))
+    g.add_argument("--parallel_search", type=int, default=0)
+    g.add_argument("--log_dir", type=str, default="logs")
+    g.add_argument("--output_config_path", type=str, default=None)
+
+
+def build_parser(mode: str, extra_args_provider: Optional[Callable] = None) -> argparse.ArgumentParser:
+    if mode not in MODES:
+        raise ValueError("mode must be one of %s, got %r" % (MODES, mode))
+    p = argparse.ArgumentParser("galvatron_tpu-%s" % mode, allow_abbrev=False)
+    p.add_argument("--config_dir", type=str, default="configs",
+                   help="where profiled/searched JSON configs live")
+    _add_model_args(p)
+    if mode in ("train", "train_dist"):
+        _add_parallel_args(p)
+        _add_train_args(p)
+        _add_profile_args(p)  # train runs double as profiling runs (reference model_profiler launches train_dist)
+    elif mode == "search":
+        _add_search_args(p)
+    elif mode == "profile":
+        _add_profile_args(p)
+        p.add_argument("--profile_type_model", dest="profile_type", type=str,
+                       default="computation", choices=("computation", "memory"))
+    elif mode == "profile_hardware":
+        _add_hardware_args(p)
+    if extra_args_provider is not None:
+        extra_args_provider(p)
+    return p
+
+
+def initialize_galvatron(extra_args_provider: Optional[Callable] = None,
+                         mode: str = "train_dist",
+                         argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
+    """Parse args for `mode`. `extra_args_provider(parser)` may add model-
+    specific flags (the reference's per-model model_args hook,
+    core/arguments.py:8-30)."""
+    args = build_parser(mode, extra_args_provider).parse_args(argv)
+    args.galvatron_mode = mode
+    return args
+
+
+# --------------------------------------------------------- args -> structures
+def hp_config_from_args(args, num_layers: int, world_size: int):
+    """GLOBAL flags or a searched JSON -> HybridParallelConfig (reference
+    get_hybrid_parallel_configs_api's two modes, hybrid_parallel_config.py:17-158)."""
+    from galvatron_tpu.config.strategy import HybridParallelConfig
+
+    if getattr(args, "galvatron_config_path", None):
+        return HybridParallelConfig.from_json(
+            args.galvatron_config_path, world_size=world_size,
+            global_bsz=args.global_train_batch_size, mixed_precision=args.mixed_precision,
+        )
+    return HybridParallelConfig.uniform(
+        world_size=world_size,
+        num_layers=num_layers,
+        pp=args.pp_deg,
+        tp=args.global_tp_deg,
+        cp=args.global_cp_deg,
+        sp=1 if args.use_ulysses else 0,
+        sdp=args.sdp,
+        checkpoint=args.checkpoint,
+        global_bsz=args.global_train_batch_size,
+        chunks=args.chunks,
+        pipeline_type=args.pipeline_type,
+        default_dp_type=args.default_dp_type,
+        vocab_tp=args.vocab_tp,
+        vocab_sp=args.vocab_sp,
+        vocab_cp=args.vocab_cp,
+        embed_sdp=args.embed_sdp,
+        mixed_precision=args.mixed_precision,
+        sequence_parallel=args.sequence_parallel,
+        cp_mode=args.cp_mode,
+    )
+
+
+def model_config_from_args(args):
+    """Resolve the model family + TransformerConfig from flags (the reference's
+    three-way manual override scheme, models/gpt_hf/meta_configs/config_utils.py:30-56)."""
+    from galvatron_tpu.models.registry import get_family
+
+    fam = get_family(args.model_type)
+    size = args.model_size or fam.default_size
+    overrides = {}
+    if args.set_model_config_manually:
+        for flag, key in (
+            ("hidden_size", "hidden_size"),
+            ("num_attention_heads", "num_heads"),
+            ("num_kv_heads", "num_kv_heads"),
+            ("ffn_hidden_size", "ffn_hidden"),
+            ("num_layers", "num_layers"),
+            ("vocab_size", "vocab_size"),
+            ("seq_length", "max_seq_len"),
+        ):
+            v = getattr(args, flag, None)
+            if v is not None:
+                overrides[key] = v
+    else:
+        if args.set_layernum_manually and args.num_layers is not None:
+            overrides["num_layers"] = args.num_layers
+        if args.set_seqlen_manually and args.seq_length is not None:
+            overrides["max_seq_len"] = args.seq_length
+    if args.mixed_precision == "bf16":
+        import jax.numpy as jnp
+
+        overrides.setdefault("compute_dtype", jnp.bfloat16)
+    cfg = fam.config_fn(size, **overrides)
+    return fam, cfg
+
+
+def uniform_strategy_args_sanity(args, world_size: int):
+    per_stage = world_size // max(args.pp_deg, 1)
+    need = args.global_tp_deg * args.global_cp_deg
+    if per_stage % need != 0:
+        raise ValueError(
+            "tp*cp=%d does not divide per-stage devices %d (world=%d pp=%d)"
+            % (need, per_stage, world_size, args.pp_deg)
+        )

@@ -1,0 +1,101 @@
+"""Search driver: the analogue of every model's ``search_dist.py``
+(reference models/gpt_hf/search_dist.py:8-22). Pure CPU: reads profiled
+JSON configs, runs the DP search, writes the optimal strategy JSON.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from galvatron_tpu.cli.arguments import initialize_galvatron, model_config_from_args
+from galvatron_tpu.search.engine import GalvatronSearchEngine, SearchArgs
+from galvatron_tpu.utils.jsonio import read_json_config
+
+
+def search_args_from(args) -> SearchArgs:
+    return SearchArgs(
+        memory_constraint=args.memory_constraint,
+        search_space=args.search_space,
+        sp_space=args.sp_space,
+        disable_dp=bool(args.disable_dp),
+        disable_tp=bool(args.disable_tp),
+        disable_vtp=bool(args.disable_vtp),
+        disable_pp=bool(args.disable_pp),
+        disable_sdp=bool(args.disable_sdp),
+        disable_ckpt=bool(args.disable_ckpt),
+        disable_tp_consec=bool(args.disable_tp_consec),
+        disable_cp=not bool(args.enable_cp),
+        max_tp_deg=args.search_max_tp_deg,
+        max_pp_deg=args.search_max_pp_deg,
+        max_cp_deg=args.max_cp_deg,
+        min_bsz=args.min_bsz,
+        max_bsz=args.max_bsz,
+        bsz_scale=args.bsz_scale,
+        settle_bsz=args.settle_bsz,
+        settle_chunk=args.settle_chunk,
+        fine_grained_mode=bool(args.fine_grained_mode),
+        use_pipeline_costmodel=bool(args.use_pipeline_costmodel),
+        mixed_precision=args.mixed_precision == "bf16",
+        default_dp_type=getattr(args, "default_dp_type", "ddp"),
+    )
+
+
+def _hardware_paths(config_dir: str, ndev: int) -> dict:
+    tag = "%dchips" % ndev
+    return {
+        "allreduce": os.path.join(config_dir, "allreduce_bandwidth_%s.json" % tag),
+        "p2p": os.path.join(config_dir, "p2p_bandwidth_%s.json" % tag),
+        "sp": os.path.join(config_dir, "sp_time_%s.json" % tag),
+        "overlap": os.path.join(config_dir, "overlap_coefficient.json"),
+    }
+
+
+def _model_paths(config_dir: str, cfg, model_name: str, precision: str, seq: int) -> dict:
+    tag = "%s_hidden%d_head%d_seqlen%d" % (precision, cfg.hidden_size, cfg.num_heads, seq)
+    return {
+        "computation": os.path.join(config_dir, "computation_profiling_%s_%s.json" % (tag, model_name)),
+        "memory": os.path.join(config_dir, "memory_profiling_%s_%s.json" % (tag, model_name)),
+    }
+
+
+def search(args, world_size: Optional[int] = None) -> dict:
+    fam, cfg = model_config_from_args(args)
+    world_size = world_size or int(os.environ.get("GALVATRON_WORLD_SIZE", "8"))
+    seq = cfg.max_seq_len
+    engine = GalvatronSearchEngine(
+        search_args_from(args),
+        world_size,
+        model_layer_configs=[
+            {"hidden_size": cfg.hidden_size, "seq_len": seq, "layer_num": cfg.num_layers}
+        ],
+        config_dir=args.config_dir,
+        model_name=args.model_type,
+    )
+    mp = _model_paths(args.config_dir, cfg, args.model_type, args.mixed_precision, seq)
+    engine.set_model_profiles(
+        read_json_config(mp["computation"]), read_json_config(mp["memory"])
+    )
+    hw = _hardware_paths(args.config_dir, world_size)
+    engine.set_hardware_profiles(
+        read_json_config(hw["allreduce"]),
+        read_json_config(hw["p2p"]) if os.path.exists(hw["p2p"]) else None,
+        read_json_config(hw["overlap"]) if os.path.exists(hw["overlap"]) else None,
+        read_json_config(hw["sp"]) if os.path.exists(hw["sp"]) else None,
+    )
+    engine.initialize_search_engine()
+    result = engine.parallelism_optimization()
+    if result is None:
+        raise RuntimeError("no feasible strategy under memory constraint %.1f GB" % args.memory_constraint)
+    path = engine.save_results(result, args.output_config_path)
+    print("saved searched strategy to %s" % path)
+    return result
+
+
+def main(argv=None):
+    args = initialize_galvatron(mode="search", argv=argv)
+    return search(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,127 @@
+"""Training driver: the analogue of every model's ``train_dist.py::train()``
+(reference models/gpt_hf/train_dist.py:19-77; llama adds checkpoint/scheduler,
+models/llama_hf/train_dist.py:30-95). One driver serves all families via the
+registry; the per-layer strategy comes from GLOBAL flags or a searched JSON
+(``--galvatron_config_path``).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Optional
+
+import jax
+import numpy as np
+
+from galvatron_tpu.cli.arguments import (
+    hp_config_from_args,
+    initialize_galvatron,
+    model_config_from_args,
+)
+from galvatron_tpu.profiler.runtime import RuntimeProfiler
+from galvatron_tpu.runtime import checkpoint as ckpt
+from galvatron_tpu.runtime.dataloader import get_train_iterator
+from galvatron_tpu.runtime.model_api import construct_hybrid_parallel_model
+from galvatron_tpu.runtime.optimizer import OptimizerArgs, get_optimizer_and_scheduler
+
+
+def optimizer_args_from(args) -> OptimizerArgs:
+    return OptimizerArgs(
+        lr=args.lr,
+        min_lr=args.min_lr,
+        weight_decay=args.weight_decay,
+        adam_beta1=args.adam_beta1,
+        adam_beta2=args.adam_beta2,
+        adam_eps=args.adam_eps,
+        clip_grad=args.clip_grad,
+        warmup_steps=args.lr_warmup_iters,
+        total_steps=args.train_iters,
+        lr_decay_style=args.lr_decay_style,
+    )
+
+
+def build_data_iterator(args, cfg, hp):
+    """Indexed dataset when --data_path is given (galvatron_tpu.data),
+    synthetic stream otherwise (the reference models' random-data fallback)."""
+    if args.data_path:
+        from galvatron_tpu.data.dataset import gpt_train_iterator
+
+        return gpt_train_iterator(
+            args.data_path, hp, seq_len=cfg.max_seq_len, seed=args.seed
+        )
+    return get_train_iterator(hp, cfg.vocab_size, cfg.max_seq_len, seed=args.seed)
+
+
+def train(args) -> dict:
+    """Returns a summary dict (losses, timing) for tests/driver use."""
+    fam, cfg = model_config_from_args(args)
+    world = args.world_size or len(jax.devices())
+    hp = hp_config_from_args(args, cfg.num_layers, world)
+    if jax.process_index() == 0:
+        print(hp.describe())
+
+    model = construct_hybrid_parallel_model(cfg, hp)
+    tx, _sched = get_optimizer_and_scheduler(optimizer_args_from(args))
+
+    params = model.init_params(jax.random.PRNGKey(args.seed))
+    opt_state = model.init_opt_state(tx, params)
+    start_iter = 0
+    if args.load:
+        params, opt_state, meta = ckpt.load_checkpoint(
+            args.load,
+            args.load_iteration,
+            params_target=params,
+            params_shardings=model.shardings(),
+            opt_state_target=opt_state,
+            opt_state_shardings=model.opt_state_shardings(tx, params),
+            hp=hp,
+        )
+        start_iter = int(meta.get("iteration", 0))
+        if jax.process_index() == 0:
+            print("resumed from %s at iteration %d" % (args.load, start_iter))
+
+    step_fn = model.make_train_step(tx)
+    data_iter = build_data_iterator(args, cfg, hp)
+    # deterministic resume: the stream must continue where the saved run
+    # stopped (the reference keeps Megatron dataset cursors in the optimizer
+    # checkpoint; here streams are stateless functions of the step index)
+    for _ in range(start_iter):
+        next(data_iter)
+    prof = RuntimeProfiler(
+        warmup=min(2, max(args.train_iters - 1, 0)),
+        rank=jax.process_index(),
+        model_name="%s_%s" % (args.model_type, args.model_size or fam.default_size),
+    )
+
+    losses = []
+    it = start_iter
+    for it in range(start_iter, args.train_iters):
+        batch = next(data_iter)
+        batch = model.shard_batch(batch)
+        prof.start(it)
+        params, opt_state, metrics = step_fn(params, opt_state, batch)
+        prof.end(it, n_samples=hp.global_bsz, outputs=metrics["loss"])
+        if args.profile or it % max(args.log_interval, 1) == 0:
+            prof.log_iteration(it, metrics)
+        losses.append(float(metrics["loss"]))
+        if args.save and args.save_interval and (it + 1) % args.save_interval == 0:
+            ckpt.save_checkpoint(args.save, it + 1, params, opt_state, hp,
+                                 train_meta={"iteration": it + 1})
+    if args.save:
+        ckpt.save_checkpoint(args.save, it + 1, params, opt_state, hp,
+                             train_meta={"iteration": it + 1})
+    summary = prof.summary()
+    summary["losses"] = losses
+    if args.profile and jax.process_index() == 0:
+        print({k: v for k, v in summary.items() if k != "losses"})
+    return summary
+
+
+def main(argv=None):
+    args = initialize_galvatron(mode="train_dist", argv=argv)
+    return train(args)
+
+
+if __name__ == "__main__":
+    main()

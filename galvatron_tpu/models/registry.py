@@ -1,0 +1,88 @@
+"""Model-family registry.
+
+The reference keeps one directory per family under ``galvatron/models/`` with a
+uniform 5-file integration surface (SURVEY.md §2.4; e.g.
+models/gpt_hf/GPTModel_hybrid_parallel.py:20-79). Here a family is one
+``ModelFamily`` record: a config constructor plus optional HF state-dict
+conversion hooks. All families share the same functional transformer
+(models/base.py) so "integration" reduces to configuration.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+
+@dataclass(frozen=True)
+class ModelFamily:
+    name: str
+    config_fn: Callable[..., Any]  # (model_size:str, **overrides) -> TransformerConfig
+    meta_configs: Dict[str, dict]
+    default_size: str
+    convert_from_hf: Optional[Callable] = None  # (state_dict, cfg) -> params
+    export_to_hf: Optional[Callable] = None  # (params, cfg) -> state_dict
+    config_from_hf: Optional[Callable] = None  # (hf_config, **overrides) -> cfg
+    # families whose sequence length varies per stage (swin) or with two layer
+    # types (t5) carry extra structure for the profiler/search engine:
+    layer_types: int = 1
+
+
+_REGISTRY: Dict[str, ModelFamily] = {}
+
+
+def register(family: ModelFamily):
+    _REGISTRY[family.name] = family
+    return family
+
+
+def get_family(name: str) -> ModelFamily:
+    _ensure_builtin()
+    if name not in _REGISTRY:
+        raise KeyError("unknown model family %r; known: %s" % (name, sorted(_REGISTRY)))
+    return _REGISTRY[name]
+
+
+def family_names():
+    _ensure_builtin()
+    return sorted(_REGISTRY)
+
+
+_LOADED = False
+
+
+def _ensure_builtin():
+    global _LOADED
+    if _LOADED:
+        return
+    _LOADED = True
+    from galvatron_tpu.models import gpt, llama
+
+    register(
+        ModelFamily(
+            name="gpt",
+            config_fn=gpt.gpt_config,
+            meta_configs=gpt.META_CONFIGS,
+            default_size="gpt-0.3b",
+            convert_from_hf=gpt.convert_hf_gpt2,
+            export_to_hf=gpt.export_hf_gpt2,
+            config_from_hf=gpt.gpt_config_from_hf,
+        )
+    )
+    register(
+        ModelFamily(
+            name="llama",
+            config_fn=llama.llama_config,
+            meta_configs=llama.META_CONFIGS,
+            default_size="llama-0.3b",
+            convert_from_hf=llama.convert_hf_llama,
+            export_to_hf=getattr(llama, "export_hf_llama", None),
+            config_from_hf=llama.llama_config_from_hf,
+        )
+    )
+    # extended families (bert/vit/t5/swin) self-register on import
+    for mod in ("bert", "vit", "t5", "swin"):
+        try:
+            __import__("galvatron_tpu.models.%s" % mod)
+        except ImportError:
+            pass

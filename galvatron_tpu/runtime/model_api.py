@@ -179,12 +179,14 @@ class HybridParallelModel:
 
         return jax.jit(train_step, donate_argnums=(0, 1))
 
-    def init_opt_state(self, tx: optax.GradientTransformation, params: Params):
+    def opt_state_shardings(self, tx: optax.GradientTransformation, params: Params):
         state_shape = jax.eval_shape(tx.init, params)
         shapes = jax.tree.map(lambda x: x, jax.eval_shape(lambda p: p, params))
         specs = opt_state_specs(state_shape, self.param_specs, shapes, self.zero_axes_tree(), self.mesh)
-        shardings = jax.tree.map(lambda s: NamedSharding(self.mesh, s), specs, is_leaf=_is_spec)
-        return jax.jit(tx.init, out_shardings=shardings)(params)
+        return jax.tree.map(lambda s: NamedSharding(self.mesh, s), specs, is_leaf=_is_spec)
+
+    def init_opt_state(self, tx: optax.GradientTransformation, params: Params):
+        return jax.jit(tx.init, out_shardings=self.opt_state_shardings(tx, params))(params)
 
 
 def construct_hybrid_parallel_model(

@@ -1,0 +1,75 @@
+"""Argument-system tests (reference arg plumbing, core/arguments.py:8-30)."""
+
+import pytest
+
+from galvatron_tpu.cli.arguments import (
+    build_parser,
+    hp_config_from_args,
+    initialize_galvatron,
+    model_config_from_args,
+)
+
+
+def test_modes_parse_defaults():
+    for mode in ("train_dist", "search", "profile", "profile_hardware"):
+        args = initialize_galvatron(mode=mode, argv=[])
+        assert args.galvatron_mode == mode
+        assert args.model_type == "llama"
+
+
+def test_extra_args_provider():
+    def extra(p):
+        p.add_argument("--my_flag", type=int, default=7)
+
+    args = initialize_galvatron(extra, mode="train_dist", argv=["--my_flag", "3"])
+    assert args.my_flag == 3
+
+
+def test_global_mode_hp_config():
+    args = initialize_galvatron(mode="train_dist", argv=[
+        "--pp_deg", "2", "--global_tp_deg", "2", "--chunks", "2",
+        "--global_train_batch_size", "8", "--default_dp_type", "zero2",
+        "--checkpoint", "1",
+    ])
+    hp = hp_config_from_args(args, num_layers=4, world_size=8)
+    assert hp.pp == 2 and hp.layers[0].tp == 2 and hp.layers[0].checkpoint == 1
+    assert hp.default_dp_type == "zero2"
+    assert hp.dp(0) == 2  # 8/(pp2*tp2)
+
+
+def test_json_mode_hp_config(tmp_path):
+    from galvatron_tpu.config.strategy import HybridParallelConfig
+
+    ref = HybridParallelConfig.uniform(world_size=8, num_layers=4, pp=1, tp=2, global_bsz=8)
+    p = tmp_path / "strategy.json"
+    ref.save(str(p))
+    args = initialize_galvatron(mode="train_dist", argv=[
+        "--galvatron_config_path", str(p), "--global_train_batch_size", "8",
+    ])
+    hp = hp_config_from_args(args, num_layers=4, world_size=8)
+    hp.assert_equal(ref)
+
+
+def test_model_config_resolution():
+    args = initialize_galvatron(mode="train_dist", argv=[
+        "--model_type", "gpt", "--model_size", "gpt-1.5b",
+    ])
+    fam, cfg = model_config_from_args(args)
+    assert fam.name == "gpt" and cfg.hidden_size == 1600 and cfg.num_layers == 48
+
+
+def test_manual_model_config_override():
+    args = initialize_galvatron(mode="train_dist", argv=[
+        "--model_type", "llama", "--set_model_config_manually", "1",
+        "--hidden_size", "256", "--num_attention_heads", "4",
+        "--num_layers", "2", "--vocab_size", "1024", "--seq_length", "128",
+    ])
+    _, cfg = model_config_from_args(args)
+    assert (cfg.hidden_size, cfg.num_heads, cfg.num_layers, cfg.vocab_size, cfg.max_seq_len) == (
+        256, 4, 2, 1024, 128)
+
+
+def test_unknown_family_raises():
+    args = initialize_galvatron(mode="train_dist", argv=["--model_type", "nope"])
+    with pytest.raises(KeyError):
+        model_config_from_args(args)
