@@ -62,6 +62,17 @@ class TransformerConfig:
     attn_impl: str = "auto"
     # initializer scales
     init_std: float = 0.02
+    # --- encoder-family extensions (bert_hf / vit_hf, SURVEY.md §2.4) ---
+    type_vocab_size: int = 0  # BERT token-type embeddings
+    embed_norm: bool = False  # LayerNorm after the embedding sum (BERT)
+    head_type: str = "lm"  # lm | mlm | classification
+    num_classes: int = 0
+    pool_type: str = "cls"  # cls | mean (classification pooling)
+    input_type: str = "tokens"  # tokens | patches (vision)
+    image_size: int = 224
+    patch_size: int = 16
+    num_channels: int = 3
+    use_cls_token: bool = False
 
     def __post_init__(self):
         if self.num_kv_heads is None:
@@ -70,6 +81,9 @@ class TransformerConfig:
             self.ffn_hidden = 4 * self.hidden_size
         if self.head_dim is None:
             self.head_dim = self.hidden_size // self.num_heads
+        if self.input_type == "patches":
+            n_patches = (self.image_size // self.patch_size) ** 2
+            self.max_seq_len = n_patches + (1 if self.use_cls_token else 0)
 
     @property
     def fused_qkv(self) -> bool:
@@ -124,22 +138,60 @@ def init_layer_params(rng: jax.Array, cfg: TransformerConfig) -> Params:
     return p
 
 
+def _norm_params(cfg: TransformerConfig) -> Params:
+    p = {"scale": jnp.ones((cfg.hidden_size,), cfg.param_dtype)}
+    if cfg.norm_type == "layernorm":
+        p["bias"] = jnp.zeros((cfg.hidden_size,), cfg.param_dtype)
+    return p
+
+
 def init_model_params(rng: jax.Array, cfg: TransformerConfig) -> Params:
     n = cfg.num_layers
-    ks = jax.random.split(rng, n + 3)
+    h = cfg.hidden_size
+    ks = jax.random.split(rng, n + 6)
+    if cfg.input_type == "patches":
+        patch_dim = cfg.patch_size * cfg.patch_size * cfg.num_channels
+        embed: Params = {
+            "patch": {
+                "kernel": _dense_init(ks[0], (patch_dim, h), cfg.init_std, cfg.param_dtype),
+                "bias": jnp.zeros((h,), cfg.param_dtype),
+            },
+            "wpe": _dense_init(ks[1], (cfg.max_seq_len, h), cfg.init_std, cfg.param_dtype),
+        }
+        if cfg.use_cls_token:
+            embed["cls_token"] = jnp.zeros((h,), cfg.param_dtype)
+    else:
+        embed = {"wte": _dense_init(ks[0], (cfg.vocab_size, h), cfg.init_std, cfg.param_dtype)}
+        if cfg.position_type == "learned":
+            embed["wpe"] = _dense_init(ks[1], (cfg.max_seq_len, h), cfg.init_std, cfg.param_dtype)
+        if cfg.type_vocab_size:
+            embed["tte"] = _dense_init(ks[n + 3], (cfg.type_vocab_size, h), cfg.init_std, cfg.param_dtype)
+    if cfg.embed_norm:
+        embed["norm"] = _norm_params(cfg)
     params: Params = {
-        "embed": {"wte": _dense_init(ks[0], (cfg.vocab_size, cfg.hidden_size), cfg.init_std, cfg.param_dtype)},
+        "embed": embed,
         "layers": [init_layer_params(ks[2 + i], cfg) for i in range(n)],
     }
-    if cfg.position_type == "learned":
-        params["embed"]["wpe"] = _dense_init(ks[1], (cfg.max_seq_len, cfg.hidden_size), cfg.init_std, cfg.param_dtype)
-    fn = {"scale": jnp.ones((cfg.hidden_size,), cfg.param_dtype)}
-    if cfg.norm_type == "layernorm":
-        fn["bias"] = jnp.zeros((cfg.hidden_size,), cfg.param_dtype)
-    params["final_norm"] = fn
-    if not cfg.tie_embeddings:
+    # post-LN models (BERT) normalise inside each block; no final norm
+    if cfg.pre_norm:
+        params["final_norm"] = _norm_params(cfg)
+    if cfg.head_type == "classification":
+        params["head"] = {
+            "kernel": _dense_init(ks[n + 4], (h, cfg.num_classes), cfg.init_std, cfg.param_dtype),
+            "bias": jnp.zeros((cfg.num_classes,), cfg.param_dtype),
+        }
+    elif cfg.head_type == "mlm":
+        params["head"] = {
+            "transform": {
+                "kernel": _dense_init(ks[n + 5], (h, h), cfg.init_std, cfg.param_dtype),
+                "bias": jnp.zeros((h,), cfg.param_dtype),
+            },
+            "norm": _norm_params(cfg),
+            "bias": jnp.zeros((cfg.vocab_size,), cfg.param_dtype),
+        }
+    if cfg.head_type in ("lm", "mlm") and not cfg.tie_embeddings:
         params["lm_head"] = {
-            "kernel": _dense_init(ks[-1], (cfg.hidden_size, cfg.vocab_size), cfg.init_std, cfg.param_dtype)
+            "kernel": _dense_init(ks[n + 2], (h, cfg.vocab_size), cfg.init_std, cfg.param_dtype)
         }
     return params
 
@@ -162,6 +214,8 @@ def _activation(x, cfg: TransformerConfig):
     # swiglu is handled at the call site on the fused (..., 2, ffn) layout
     if cfg.activation == "gelu":
         return jax.nn.gelu(x, approximate=True)
+    if cfg.activation == "gelu_exact":
+        return jax.nn.gelu(x, approximate=False)
     if cfg.activation == "relu":
         return jax.nn.relu(x)
     raise ValueError(cfg.activation)
@@ -218,6 +272,12 @@ def layer_forward(
     if axes is not None and mesh is not None and len(axes.cp) > 0:
         from galvatron_tpu.ops.ring_attention import ring_attention
 
+        if attn_bias is not None:
+            raise NotImplementedError(
+                "attention bias / padding masks are not supported under context "
+                "parallelism (the reference's zigzag ring path is causal-only, "
+                "transformer.py:2335-2670)"
+            )
         attn = ring_attention(q, k, v, positions, mesh=mesh, axes=axes, causal=cfg.causal)
     else:
         attn = core_attention(q, k, v, causal=cfg.causal, bias=attn_bias, impl=cfg.attn_impl)
@@ -249,7 +309,8 @@ def layer_forward(
 
 # ============================================================== model forward
 def embed_tokens(p_embed: Params, tokens: jax.Array, positions: jax.Array, cfg: TransformerConfig,
-                 mesh: Optional[Mesh] = None, vax: Optional[LayerAxes] = None) -> jax.Array:
+                 mesh: Optional[Mesh] = None, vax: Optional[LayerAxes] = None,
+                 token_type_ids: Optional[jax.Array] = None) -> jax.Array:
     """Vocab-parallel embedding. With the table sharded on vocab, the one-hot
     einsum partitions into masked local lookup + psum — exactly Megatron's
     VocabParallelEmbedding (reference GPTModel_tensor_parallel.py:84-132),
@@ -263,16 +324,74 @@ def embed_tokens(p_embed: Params, tokens: jax.Array, positions: jax.Array, cfg: 
         x = wte.astype(cfg.compute_dtype)[tokens]
     if cfg.position_type == "learned":
         x = x + p_embed["wpe"].astype(cfg.compute_dtype)[positions]
+    if cfg.type_vocab_size:
+        tti = token_type_ids if token_type_ids is not None else jnp.zeros_like(tokens)
+        x = x + p_embed["tte"].astype(cfg.compute_dtype)[tti]
+    if cfg.embed_norm:
+        x = _norm(x, p_embed["norm"], cfg)
+    return x
+
+
+def patchify(pixels: jax.Array, patch: int) -> jax.Array:
+    """(B, H, W, C) image -> (B, N, patch*patch*C) patch vectors. A dense on
+    this layout equals the stride-`patch` conv patch embedding (HF ViT
+    projection) and keeps the op a plain MXU matmul."""
+    b, hh, ww, c = pixels.shape
+    gh, gw = hh // patch, ww // patch
+    x = pixels.reshape(b, gh, patch, gw, patch, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, gh * gw, patch * patch * c)
+
+
+def embed_patches(p_embed: Params, pixels: jax.Array, cfg: TransformerConfig) -> jax.Array:
+    """ViT patch embedding: patchify + dense + [cls token] + learned positions."""
+    dtype = cfg.compute_dtype
+    x = patchify(pixels.astype(dtype), cfg.patch_size)
+    x = _dense(x, p_embed["patch"], dtype)
+    if cfg.use_cls_token:
+        cls = jnp.broadcast_to(
+            p_embed["cls_token"].astype(dtype), (x.shape[0], 1, cfg.hidden_size)
+        )
+        x = jnp.concatenate([cls, x], axis=1)
+    x = x + p_embed["wpe"].astype(dtype)[: x.shape[1]]
+    if cfg.embed_norm:
+        x = _norm(x, p_embed["norm"], cfg)
     return x
 
 
 def lm_logits(params: Params, x: jax.Array, cfg: TransformerConfig) -> jax.Array:
-    x = _norm(x, params["final_norm"], cfg)
+    if cfg.pre_norm:
+        x = _norm(x, params["final_norm"], cfg)
     if cfg.tie_embeddings:
         kernel = params["embed"]["wte"].astype(cfg.compute_dtype).T
     else:
         kernel = params["lm_head"]["kernel"].astype(cfg.compute_dtype)
     return x @ kernel
+
+
+def model_head(params: Params, x: jax.Array, cfg: TransformerConfig) -> jax.Array:
+    """Dispatch to the family's output head (reference `Cls_` modules,
+    models/gpt_hf/GPTModel_sequential.py:201-215 and the bert/vit analogues)."""
+    if cfg.head_type == "lm":
+        return lm_logits(params, x, cfg)
+    if cfg.head_type == "mlm":
+        if cfg.pre_norm:
+            x = _norm(x, params["final_norm"], cfg)
+        hp_ = params["head"]
+        y = _dense(x, hp_["transform"], cfg.compute_dtype)
+        y = jax.nn.gelu(y, approximate=False)
+        y = _norm(y, hp_["norm"], cfg)
+        if cfg.tie_embeddings:
+            kernel = params["embed"]["wte"].astype(cfg.compute_dtype).T
+        else:
+            kernel = params["lm_head"]["kernel"].astype(cfg.compute_dtype)
+        return y @ kernel + hp_["bias"].astype(cfg.compute_dtype)
+    if cfg.head_type == "classification":
+        if cfg.pre_norm:
+            x = _norm(x, params["final_norm"], cfg)
+        pooled = x[:, 0] if cfg.pool_type == "cls" else jnp.mean(x, axis=1)
+        return _dense(pooled, params["head"], cfg.compute_dtype)
+    raise ValueError(cfg.head_type)
 
 
 def vocab_parallel_cross_entropy(logits: jax.Array, labels: jax.Array,
@@ -299,6 +418,33 @@ def vocab_parallel_cross_entropy(logits: jax.Array, labels: jax.Array,
     return jnp.sum(losses * loss_mask) / jnp.maximum(jnp.sum(loss_mask), 1.0)
 
 
+def run_layers(
+    params: Params,
+    x: jax.Array,
+    positions: jax.Array,
+    cfg: TransformerConfig,
+    hp: Optional[HybridParallelConfig] = None,
+    mesh: Optional[Mesh] = None,
+    attn_bias: Optional[jax.Array] = None,
+) -> jax.Array:
+    """The encoder stack with per-layer sharding constraints and remat."""
+    use_hp = hp is not None and mesh is not None
+    for i, lp in enumerate(params["layers"]):
+        axes = layer_axes(hp, i) if use_hp else None
+        if use_hp:
+            x = S.constrain(x, mesh, S.act_spec(axes))
+        fwd = partial(layer_forward, cfg=cfg, mesh=mesh, axes=axes, attn_bias=attn_bias)
+        if use_hp and hp.layers[i].checkpoint:
+            fwd = jax.checkpoint(fwd)
+        x = fwd(lp, x, positions)
+    return x
+
+
+def padding_attn_bias(attn_mask: jax.Array) -> jax.Array:
+    """(B, S) 1/0 key-validity mask -> additive (B, 1, 1, S) bias."""
+    return (1.0 - attn_mask.astype(jnp.float32))[:, None, None, :] * -1e9
+
+
 def model_forward(
     params: Params,
     tokens: jax.Array,
@@ -306,34 +452,52 @@ def model_forward(
     cfg: TransformerConfig,
     hp: Optional[HybridParallelConfig] = None,
     mesh: Optional[Mesh] = None,
+    token_type_ids: Optional[jax.Array] = None,
+    attn_mask: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Full forward to logits (single pipeline stage; pipelined execution lives
-    in parallel/pipeline.py). Applies per-layer sharding constraints and remat."""
+    in parallel/pipeline.py)."""
     use_hp = hp is not None and mesh is not None
     vax = vocab_axes(hp) if use_hp else None
-    x = embed_tokens(params["embed"], tokens, positions, cfg, mesh, vax)
+    if cfg.input_type == "patches":
+        x = embed_patches(params["embed"], tokens, cfg)
+    else:
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(tokens.shape[1]), tokens.shape)
+        x = embed_tokens(params["embed"], tokens, positions, cfg, mesh, vax,
+                         token_type_ids=token_type_ids)
     if use_hp:
         x = S.constrain(x, mesh, S.act_spec(vax))
-    for i, lp in enumerate(params["layers"]):
-        axes = layer_axes(hp, i) if use_hp else None
-        if use_hp:
-            x = S.constrain(x, mesh, S.act_spec(axes))
-        fwd = partial(layer_forward, cfg=cfg, mesh=mesh, axes=axes)
-        if use_hp and hp.layers[i].checkpoint:
-            fwd = jax.checkpoint(fwd)
-        x = fwd(lp, x, positions)
+    bias = padding_attn_bias(attn_mask) if attn_mask is not None else None
+    x = run_layers(params, x, positions, cfg, hp, mesh, attn_bias=bias)
     if use_hp:
         x = S.constrain(x, mesh, S.act_spec(vax))
-    logits = lm_logits(params, x, cfg)
-    if use_hp:
+    logits = model_head(params, x, cfg)
+    if use_hp and cfg.head_type in ("lm", "mlm"):
         logits = S.constrain(logits, mesh, S.logits_spec(vax))
     return logits
 
 
 def lm_loss_fn(params, batch, cfg, hp=None, mesh=None):
-    """batch: dict(tokens, positions, labels, loss_mask?)."""
-    logits = model_forward(params, batch["tokens"], batch["positions"], cfg, hp, mesh)
+    """batch: dict(tokens, positions, labels, loss_mask?, token_type_ids?,
+    attn_mask?). Serves lm and mlm heads (token-level CE)."""
+    logits = model_forward(
+        params, batch["tokens"], batch["positions"], cfg, hp, mesh,
+        token_type_ids=batch.get("token_type_ids"), attn_mask=batch.get("attn_mask"),
+    )
     return vocab_parallel_cross_entropy(logits, batch["labels"], batch.get("loss_mask"))
+
+
+def classification_loss_fn(params, batch, cfg, hp=None, mesh=None):
+    """batch: dict(pixels | tokens, labels). Mean softmax CE over classes
+    (reference vit/swin `Cls_` heads)."""
+    inputs = batch.get("pixels", batch.get("tokens"))
+    logits = model_forward(params, inputs, batch.get("positions"), cfg, hp, mesh,
+                           attn_mask=batch.get("attn_mask"))
+    logits32 = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits32, axis=-1)
+    nll = -jnp.take_along_axis(logp, batch["labels"][:, None], axis=-1)[:, 0]
+    return jnp.mean(nll)
 
 
 # ============================================================== param specs
@@ -375,17 +539,37 @@ def layer_param_specs(cfg: TransformerConfig, axes: LayerAxes) -> Params:
 
 def model_param_specs(cfg: TransformerConfig, hp: HybridParallelConfig) -> Params:
     vax = vocab_axes(hp)
+    r1 = S.replicated_1d_spec(vax)
+    norm_spec = {"scale": r1} if cfg.norm_type == "rmsnorm" else {"scale": r1, "bias": r1}
+    if cfg.input_type == "patches":
+        embed: Params = {"patch": {"kernel": P(None, None), "bias": r1}, "wpe": P(None, None)}
+        if cfg.use_cls_token:
+            embed["cls_token"] = r1
+    else:
+        embed = {"wte": S.vocab_embed_spec(vax)}
+        if cfg.position_type == "learned":
+            embed["wpe"] = P(None, None)
+        if cfg.type_vocab_size:
+            embed["tte"] = P(None, None)
+    if cfg.embed_norm:
+        embed["norm"] = dict(norm_spec)
     specs: Params = {
-        "embed": {"wte": S.vocab_embed_spec(vax)},
+        "embed": embed,
         "layers": [layer_param_specs(cfg, layer_axes(hp, i)) for i in range(cfg.num_layers)],
-        "final_norm": {"scale": S.replicated_1d_spec(vax)}
-        if cfg.norm_type == "rmsnorm"
-        else {"scale": S.replicated_1d_spec(vax), "bias": S.replicated_1d_spec(vax)},
     }
-    if cfg.position_type == "learned":
-        specs["embed"]["wpe"] = P(None, None)
-    if not cfg.tie_embeddings:
+    if cfg.pre_norm:
+        specs["final_norm"] = dict(norm_spec)
+    vocab_col = P(None, None) if vax.ulysses else P(None, S._ax(vax.tp))
+    if cfg.head_type == "classification":
+        specs["head"] = {"kernel": P(None, None), "bias": P(None)}
+    elif cfg.head_type == "mlm":
+        specs["head"] = {
+            "transform": {"kernel": P(None, None), "bias": r1},
+            "norm": dict(norm_spec),
+            "bias": P(None) if vax.ulysses else P(S._ax(vax.tp)),
+        }
+    if cfg.head_type in ("lm", "mlm") and not cfg.tie_embeddings:
         # lm head is column-parallel over the vocab dim (vocab-parallel
         # logits); vocab-dense under vocab-SP, matching logits_spec
-        specs["lm_head"] = {"kernel": P(None, None) if vax.ulysses else P(None, S._ax(vax.tp))}
+        specs["lm_head"] = {"kernel": vocab_col}
     return specs

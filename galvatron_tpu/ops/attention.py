@@ -93,6 +93,10 @@ def core_attention(
         long_seq = q.shape[1] > 2048
         impl = "flash" if (on_tpu and ok_shapes and long_seq) else "xla"
     if impl == "flash":
+        if bias is not None:
+            # the pallas flash kernel takes no additive bias; fall back rather
+            # than silently dropping a padding mask
+            return _xla_attention(q, k, v, causal=causal, sm_scale=sm_scale, bias=bias)
         return _pallas_flash(q, k, v, causal=causal, sm_scale=sm_scale)
     if impl == "xla":
         return _xla_attention(q, k, v, causal=causal, sm_scale=sm_scale, bias=bias)

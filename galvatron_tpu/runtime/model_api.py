@@ -71,15 +71,25 @@ class HybridParallelModel:
         init = jax.jit(self._init_fn, out_shardings=self.shardings())
         return init(rng)
 
-    def batch_specs(self, batch_example: Dict[str, Any]):
+    def _batch_spec_for(self, x) -> P:
+        """(B, S) token-shaped entries shard over (dp, seq); rank-1 labels over
+        dp; higher-rank entries (pixels) shard batch only."""
         vax = vocab_axes(self.hp)
-        tok = P(S._ax(vax.batch_axes), S._ax(vax.seq_axes))
-        return {k: tok for k in batch_example}
+        ndim = getattr(x, "ndim", None) or len(getattr(x, "shape", ()))
+        if ndim == 2:
+            return P(S._ax(vax.batch_axes), S._ax(vax.seq_axes))
+        if ndim == 1:
+            return P(S._ax(vax.batch_axes))
+        return P(*([S._ax(vax.batch_axes)] + [None] * (ndim - 1)))
+
+    def batch_specs(self, batch_example: Dict[str, Any]):
+        return {k: self._batch_spec_for(v) for k, v in batch_example.items()}
 
     def shard_batch(self, batch):
-        vax = vocab_axes(self.hp)
-        spec = P(S._ax(vax.batch_axes), S._ax(vax.seq_axes))
-        return jax.device_put(batch, NamedSharding(self.mesh, spec))
+        return {
+            k: jax.device_put(v, NamedSharding(self.mesh, self._batch_spec_for(v)))
+            for k, v in batch.items()
+        }
 
     # -------------------------------------------------------------- train step
     def zero_axes_tree(self):
@@ -91,22 +101,17 @@ class HybridParallelModel:
 
         ps = self.param_specs
         vax = vocab_axes(self.hp)
-        out = {
-            "embed": for_axes(vax, ps["embed"]),
-            "final_norm": for_axes(vax, ps["final_norm"]),
-        }
-        if "layers" in ps:
-            out["layers"] = [
-                for_axes(layer_axes(self.hp, i), ps["layers"][i])
-                for i in range(len(ps["layers"]))
-            ]
-        else:
-            out["stages"] = [
-                for_axes(layer_axes(self.hp, j), ps["stages"][j])
-                for j in range(len(ps["stages"]))
-            ]
-        if "lm_head" in ps:
-            out["lm_head"] = for_axes(vax, ps["lm_head"])
+        layer_lists = ("layers", "stages", "enc_layers", "dec_layers")
+        out = {}
+        offset = 0
+        for key, sub in ps.items():
+            if key in layer_lists:
+                out[key] = [
+                    for_axes(layer_axes(self.hp, offset + i), sub[i]) for i in range(len(sub))
+                ]
+                offset += len(sub)
+            else:
+                out[key] = for_axes(vax, sub)
         return out
 
     def grad_accum_specs(self):
@@ -197,6 +202,11 @@ def construct_hybrid_parallel_model(
 ) -> HybridParallelModel:
     mesh = build_mesh(hp, devices)
     specs = M.model_param_specs(cfg, hp)
+    if hp.pp > 1 and cfg.head_type != "lm":
+        raise NotImplementedError(
+            "pp>1 currently supports head_type='lm' only (the scan pipeline ends "
+            "in lm_logits); mlm/classification heads run with pp=1 strategies"
+        )
     if hp.pp > 1:
         from galvatron_tpu.parallel.pipeline import make_pipelined_loss, stack_layer_specs
 
@@ -204,9 +214,18 @@ def construct_hybrid_parallel_model(
         del specs["layers"]
         base_loss = make_pipelined_loss(cfg, hp, mesh)
         fwd = None
+    elif cfg.head_type == "classification":
+        base_loss = lambda p, b: M.classification_loss_fn(p, b, cfg, hp, mesh)
+        fwd = lambda p, b: M.model_forward(
+            p, b.get("pixels", b.get("tokens")), b.get("positions"), cfg, hp, mesh,
+            attn_mask=b.get("attn_mask"),
+        )
     else:
         base_loss = lambda p, b: M.lm_loss_fn(p, b, cfg, hp, mesh)
-        fwd = lambda p, b: M.model_forward(p, b["tokens"], b["positions"], cfg, hp, mesh)
+        fwd = lambda p, b: M.model_forward(
+            p, b["tokens"], b["positions"], cfg, hp, mesh,
+            token_type_ids=b.get("token_type_ids"), attn_mask=b.get("attn_mask"),
+        )
     return HybridParallelModel(
         cfg=cfg,
         hp=hp,
