@@ -243,7 +243,14 @@ def model_config_from_args(args):
         import jax.numpy as jnp
 
         overrides.setdefault("compute_dtype", jnp.bfloat16)
-    cfg = fam.config_fn(size, **overrides)
+    try:
+        cfg = fam.config_fn(size, **overrides)
+    except TypeError as e:
+        raise ValueError(
+            "model overrides %s not supported by family %r (%s); t5/swin use "
+            "their own config fields — pass sizes via --model_size or the "
+            "family config_fn" % (sorted(overrides), fam.name, e)
+        ) from None
     return fam, cfg
 
 

@@ -44,11 +44,30 @@ def optimizer_args_from(args) -> OptimizerArgs:
 def build_data_iterator(args, cfg, hp):
     """Indexed dataset when --data_path is given (galvatron_tpu.data),
     synthetic stream otherwise (the reference models' random-data fallback)."""
+    token_lm = getattr(cfg, "input_type", "tokens") == "tokens" and not hasattr(cfg, "num_enc_layers")
     if args.data_path:
+        if not token_lm:
+            raise ValueError(
+                "--data_path provides a token LM stream; family %r needs its own "
+                "input pipeline (synthetic fallback runs without --data_path)"
+                % type(cfg).__name__
+            )
         from galvatron_tpu.data.dataset import gpt_train_iterator
 
         return gpt_train_iterator(
             args.data_path, hp, seq_len=cfg.max_seq_len, seed=args.seed
+        )
+    if getattr(cfg, "input_type", "tokens") == "patches":
+        from galvatron_tpu.runtime.dataloader import get_vision_train_iterator
+
+        return get_vision_train_iterator(
+            hp, cfg.image_size, cfg.num_channels, cfg.num_classes, seed=args.seed
+        )
+    if hasattr(cfg, "num_enc_layers"):  # encoder-decoder (t5)
+        from galvatron_tpu.runtime.dataloader import get_seq2seq_train_iterator
+
+        return get_seq2seq_train_iterator(
+            hp, cfg.vocab_size, cfg.max_seq_len, cfg.max_seq_len, seed=args.seed
         )
     return get_train_iterator(hp, cfg.vocab_size, cfg.max_seq_len, seed=args.seed)
 
@@ -61,7 +80,8 @@ def train(args) -> dict:
     if jax.process_index() == 0:
         print(hp.describe())
 
-    model = construct_hybrid_parallel_model(cfg, hp)
+    # families with their own param tree (t5/swin) supply a build hook
+    model = fam.build(cfg, hp) if fam.build else construct_hybrid_parallel_model(cfg, hp)
     tx, _sched = get_optimizer_and_scheduler(optimizer_args_from(args))
 
     params = model.init_params(jax.random.PRNGKey(args.seed))

@@ -67,23 +67,7 @@ def bert_config_from_hf(hf_config, **overrides) -> TransformerConfig:
     )
 
 
-def _np(t):
-    return np.asarray(t.detach().cpu().numpy() if hasattr(t, "detach") else t, np.float32)
-
-
-def _linear(state_dict, name):
-    """torch Linear stores (out, in); we store (in, out)."""
-    return _np(state_dict[name + ".weight"]).T, _np(state_dict[name + ".bias"])
-
-
-def _stack_qkv(state_dict, prefix, h, nh, hd):
-    """Separate q/k/v Linears -> fused head-major (h, 3, nh, hd) kernel."""
-    ks, bs = [], []
-    for role in ("query", "key", "value"):
-        w, b = _linear(state_dict, prefix + role)
-        ks.append(w.reshape(h, nh, hd))
-        bs.append(b.reshape(nh, hd))
-    return np.stack(ks, axis=1), np.stack(bs, axis=0)
+from galvatron_tpu.models.hf_utils import linear as _linear, stack_qkv as _stack_qkv, to_np as _np
 
 
 def convert_hf_bert(state_dict: Dict[str, Any], cfg: TransformerConfig) -> Dict[str, Any]:

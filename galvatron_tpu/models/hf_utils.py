@@ -1,0 +1,28 @@
+"""Shared helpers for HF state-dict conversion (used by every family's
+converter — the analogue of the common slicing code in the reference's
+tools/checkpoint_convert_h2g.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def to_np(t) -> np.ndarray:
+    """torch tensor or array-like -> float32 numpy."""
+    return np.asarray(t.detach().cpu().numpy() if hasattr(t, "detach") else t, np.float32)
+
+
+def linear(state_dict, name):
+    """torch Linear stores (out, in); we store (in, out). Returns (kernel, bias)."""
+    return to_np(state_dict[name + ".weight"]).T, to_np(state_dict[name + ".bias"])
+
+
+def stack_qkv(state_dict, prefix, h, nh, hd, roles=("query", "key", "value")):
+    """Separate q/k/v Linears -> fused head-major (h, 3, nh, hd) kernel +
+    (3, nh, hd) bias."""
+    ks, bs = [], []
+    for role in roles:
+        w, b = linear(state_dict, prefix + role)
+        ks.append(w.reshape(h, nh, hd))
+        bs.append(b.reshape(nh, hd))
+    return np.stack(ks, axis=1), np.stack(bs, axis=0)

@@ -26,6 +26,10 @@ class ModelFamily:
     # families whose sequence length varies per stage (swin) or with two layer
     # types (t5) carry extra structure for the profiler/search engine:
     layer_types: int = 1
+    # optional family-specific model constructor (cfg, hp, devices=None) ->
+    # HybridParallelModel; used by families whose param tree / forward differ
+    # from the generic decoder stack (t5, swin)
+    build: Optional[Callable] = None
 
 
 _REGISTRY: Dict[str, ModelFamily] = {}

@@ -103,6 +103,13 @@ class ModelProfiler:
 
     def __init__(self, cfg: M.TransformerConfig, model_name: str = "model",
                  args: Optional[ModelProfileArgs] = None):
+        if not isinstance(cfg, M.TransformerConfig):
+            raise TypeError(
+                "ModelProfiler profiles one TransformerConfig layer type; for "
+                "multi-layer-type families (t5) profile each layer type with "
+                "its own equivalent TransformerConfig (reference "
+                "model_profiler.py:71-75 profiles swin/t5 per layer list)"
+            )
         self.cfg = cfg
         self.model_name = model_name
         self.args = args or ModelProfileArgs()
@@ -130,20 +137,22 @@ class ModelProfiler:
         cfg = dataclasses.replace(self.cfg, num_layers=max(n_layers, 1), max_seq_len=max(seq, self.cfg.max_seq_len))
         params = M.init_model_params(jax.random.PRNGKey(0), cfg)
         params["layers"] = params["layers"][:n_layers]
-        tokens = jax.random.randint(jax.random.PRNGKey(1), (bsz, seq), 0, cfg.vocab_size)
-        batch = {
-            "tokens": tokens,
-            "positions": jnp.broadcast_to(jnp.arange(seq), (bsz, seq)),
-            "labels": jnp.roll(tokens, -1, 1),
-        }
-
-        def loss(params, batch):
-            x = M.embed_tokens(params["embed"], batch["tokens"], batch["positions"], cfg)
-            for lp in params["layers"]:
-                x = M.layer_forward(lp, x, batch["positions"], cfg)
-            logits = M.lm_logits(params, x, cfg)
-            return M.vocab_parallel_cross_entropy(logits, batch["labels"])
-
+        if cfg.input_type == "patches":
+            batch = {
+                "pixels": jax.random.normal(
+                    jax.random.PRNGKey(1), (bsz, cfg.image_size, cfg.image_size, cfg.num_channels)
+                ),
+                "labels": jax.random.randint(jax.random.PRNGKey(2), (bsz,), 0, max(cfg.num_classes, 1)),
+            }
+            loss = lambda p, b: M.classification_loss_fn(p, b, cfg)
+        else:
+            tokens = jax.random.randint(jax.random.PRNGKey(1), (bsz, seq), 0, cfg.vocab_size)
+            batch = {
+                "tokens": tokens,
+                "positions": jnp.broadcast_to(jnp.arange(seq), (bsz, seq)),
+                "labels": jnp.roll(tokens, -1, 1),
+            }
+            loss = lambda p, b: M.lm_loss_fn(p, b, cfg)
         return loss, params, batch
 
     # ------------------------------------------------------------ computation
@@ -226,8 +235,11 @@ class ModelProfiler:
         same convention MemoryCostModel applies to layer parameter_size."""
         loss, params, batch = self._full_model(0, bsz, seq)
         embed_mb = _tree_bytes(params["embed"]) / MB
-        head_mb = embed_mb if self.cfg.tie_embeddings else _tree_bytes(params.get("lm_head", {})) / MB
-        norm_mb = _tree_bytes(params["final_norm"]) / MB
+        if self.cfg.head_type in ("lm", "mlm") and self.cfg.tie_embeddings:
+            head_mb = embed_mb + _tree_bytes(params.get("head", {})) / MB
+        else:
+            head_mb = (_tree_bytes(params.get("lm_head", {})) + _tree_bytes(params.get("head", {}))) / MB
+        norm_mb = _tree_bytes(params.get("final_norm", {})) / MB
         act_total = _compiled_peak_bytes(lambda p, b: jax.grad(loss)(p, b), (params, batch))
         act_total = max(act_total - 2 * _tree_bytes(params), 1024.0) / MB
 

@@ -78,3 +78,37 @@ def get_train_iterator(
     hp: HybridParallelConfig, vocab_size: int, seq_len: int, seed: int = 1234
 ) -> Iterator[Dict[str, jnp.ndarray]]:
     return RandomTextDataset(vocab_size, seq_len, seed=seed).iterator(hp)
+
+
+def get_seq2seq_train_iterator(
+    hp: HybridParallelConfig, vocab_size: int, enc_seq_len: int, dec_seq_len: int,
+    seed: int = 1234,
+) -> Iterator[Dict[str, jnp.ndarray]]:
+    """Synthetic encoder-decoder stream (t5: tokens/dec_tokens/labels)."""
+    step = 0
+    while True:
+        rng = np.random.RandomState(seed + step)
+        dec = rng.randint(0, vocab_size, (hp.global_bsz, dec_seq_len))
+        yield {
+            "tokens": jnp.asarray(rng.randint(0, vocab_size, (hp.global_bsz, enc_seq_len))),
+            "dec_tokens": jnp.asarray(dec),
+            "labels": jnp.asarray(np.roll(dec, -1, axis=1)),
+        }
+        step += 1
+
+
+def get_vision_train_iterator(
+    hp: HybridParallelConfig, image_size: int, num_channels: int, num_classes: int,
+    seed: int = 1234,
+) -> Iterator[Dict[str, jnp.ndarray]]:
+    """Synthetic image-classification stream (vit/swin: pixels/labels)."""
+    step = 0
+    while True:
+        rng = np.random.RandomState(seed + step)
+        yield {
+            "pixels": jnp.asarray(
+                rng.randn(hp.global_bsz, image_size, image_size, num_channels).astype(np.float32)
+            ),
+            "labels": jnp.asarray(rng.randint(0, num_classes, (hp.global_bsz,))),
+        }
+        step += 1

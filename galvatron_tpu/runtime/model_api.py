@@ -48,6 +48,8 @@ class HybridParallelModel:
     param_specs: Params
     loss_fn: Callable  # (params, batch) -> loss
     forward_fn: Callable  # (params, batch) -> logits
+    init_fn: Optional[Callable] = None  # (rng) -> params; families with their
+    # own param tree (t5/swin) supply this instead of base.init_model_params
 
     # ------------------------------------------------------------------ params
     def shardings(self, specs=None):
@@ -57,6 +59,8 @@ class HybridParallelModel:
         )
 
     def _init_fn(self, rng) -> Params:
+        if self.init_fn is not None:
+            return self.init_fn(rng)
         params = M.init_model_params(rng, self.cfg)
         if self.hp.pp > 1:
             from galvatron_tpu.parallel.pipeline import stack_params
@@ -101,7 +105,7 @@ class HybridParallelModel:
 
         ps = self.param_specs
         vax = vocab_axes(self.hp)
-        layer_lists = ("layers", "stages", "enc_layers", "dec_layers")
+        layer_lists = ("layers", "stages", "enc_layers", "dec_layers", "blocks")
         out = {}
         offset = 0
         for key, sub in ps.items():

@@ -1,0 +1,92 @@
+"""Swin parity vs HuggingFace and hybrid-parallel training (reference
+galvatron/models/swin/; per-stage layer lists per model_profiler.py:71-75)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from galvatron_tpu.models.swin import (
+    construct_swin_model,
+    convert_hf_swin,
+    swin_config,
+    swin_config_from_hf,
+    swin_forward,
+)
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+pytestmark = [pytest.mark.model]
+
+
+def _tiny_hf_cfg():
+    # stage0: 8x8 grid, window 4 -> block 1 uses shifted windows;
+    # stage1: 4x4 == window -> shift forced off (both paths covered)
+    return transformers.SwinConfig(
+        image_size=32, patch_size=4, num_channels=3, embed_dim=16,
+        depths=[2, 2], num_heads=[2, 4], window_size=4, mlp_ratio=2.0,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        drop_path_rate=0.0,
+    )
+
+
+def test_swin_logit_parity():
+    hf_cfg = _tiny_hf_cfg()
+    hf_cfg.num_labels = 10
+    torch.manual_seed(0)
+    hf = transformers.SwinForImageClassification(hf_cfg).eval()
+    cfg = swin_config_from_hf(hf_cfg, num_classes=10, compute_dtype=jnp.float32)
+    params = convert_hf_swin(hf.state_dict(), cfg)
+
+    rng = np.random.RandomState(0)
+    pixels = rng.randn(2, 3, 32, 32).astype(np.float32)
+    with torch.no_grad():
+        ref = hf(torch.tensor(pixels)).logits.numpy()
+    got = swin_forward(params, jnp.asarray(pixels.transpose(0, 2, 3, 1)), cfg)
+    np.testing.assert_allclose(np.asarray(got), ref, atol=2e-3, rtol=2e-3)
+
+
+def test_swin_hybrid_training(devices8):
+    """Flat per-block strategies across stages (tp=2 + ckpt on stage-1 blocks)."""
+    import optax
+
+    from galvatron_tpu.config.strategy import HybridParallelConfig, LayerStrategy
+
+    cfg = swin_config(
+        "swin-tiny", embed_dim=16, depths=(2, 2), num_heads=(2, 4),
+        image_size=32, patch_size=4, window=4, mlp_ratio=2.0, num_classes=10,
+        compute_dtype=jnp.float32,
+    )
+    layers = [LayerStrategy(tp=2)] * 2 + [LayerStrategy(tp=2, checkpoint=1)] * 2
+    hp = HybridParallelConfig(world_size=8, pp=1, layers=layers, global_bsz=8,
+                              default_dp_type="zero2")
+    m = construct_swin_model(cfg, hp)
+    params = m.init_params(jax.random.PRNGKey(0))
+    tx = optax.adam(3e-3)
+    opt = m.init_opt_state(tx, params)
+    step = m.make_train_step(tx)
+
+    rng = np.random.RandomState(0)
+    batch = m.shard_batch(
+        dict(
+            pixels=jnp.asarray(rng.randn(8, 32, 32, 3).astype(np.float32)),
+            labels=jnp.asarray(rng.randint(0, 10, (8,))),
+        )
+    )
+    losses = []
+    for _ in range(8):
+        params, opt, mets = step(params, opt, batch)
+        losses.append(float(mets["loss"]))
+    assert losses[-1] < losses[0] - 0.3, losses
+
+
+def test_swin_block_count_mismatch_raises():
+    from galvatron_tpu.config.strategy import HybridParallelConfig
+
+    cfg = swin_config("swin-tiny", embed_dim=16, depths=(2, 2), num_heads=(2, 4),
+                      image_size=32, patch_size=4, window=4)
+    hp = HybridParallelConfig.uniform(8, 3, global_bsz=8)
+    with pytest.raises(ValueError, match="4 blocks"):
+        construct_swin_model(cfg, hp)

@@ -119,3 +119,37 @@ def test_runtime_profiler_save(tmp_path):
     from galvatron_tpu.utils.jsonio import read_json_config
 
     assert read_json_config(p)["tiny"]["iters"] == 1
+
+
+def test_profiler_bert_and_vit_families(tmp_path):
+    """Profiler must handle post-LN MLM (no final_norm) and patch-input
+    classification trees (review finding: new families crashed _full_model)."""
+    import jax.numpy as jnp
+
+    from galvatron_tpu.models.bert import bert_config
+    from galvatron_tpu.models.vit import vit_config
+    from galvatron_tpu.profiler.model import ModelProfileArgs, ModelProfiler
+
+    args = ModelProfileArgs(
+        profile_batch_size=2, layernum_min=1, layernum_max=2, warmup=0, iters=1,
+        max_tp_deg=2, mixed_precision="fp32", config_dir=str(tmp_path),
+    )
+    for cfg, name in (
+        (bert_config("bert-base", hidden_size=32, num_heads=2, num_layers=2,
+                     vocab_size=64, max_seq_len=16, compute_dtype=jnp.float32), "bert"),
+        (vit_config("vit-base", hidden_size=32, num_heads=2, num_layers=2, ffn_hidden=64,
+                    image_size=16, patch_size=8, num_classes=4, compute_dtype=jnp.float32), "vit"),
+    ):
+        res = ModelProfiler(cfg, name, args).profile_all(write=False)
+        assert res["computation"]["layertype_0"] > 0
+        assert res["memory"]["layertype_0"]["parameter_size"] > 0
+
+
+def test_profiler_rejects_multi_layer_type_config():
+    import pytest as _pytest
+
+    from galvatron_tpu.models.t5 import t5_config
+    from galvatron_tpu.profiler.model import ModelProfiler
+
+    with _pytest.raises(TypeError, match="layer type"):
+        ModelProfiler(t5_config("t5-small"))
