@@ -41,35 +41,40 @@ def optimizer_args_from(args) -> OptimizerArgs:
     )
 
 
-def build_data_iterator(args, cfg, hp):
-    """Indexed dataset when --data_path is given (galvatron_tpu.data),
-    synthetic stream otherwise (the reference models' random-data fallback)."""
-    token_lm = getattr(cfg, "input_type", "tokens") == "tokens" and not hasattr(cfg, "num_enc_layers")
+def build_data_iterator(args, fam, cfg, hp, start_step: int = 0):
+    """Per-family input pipeline (fam.data_kind): indexed dataset when
+    --data_path is given, synthetic stream otherwise (the reference models'
+    random-data fallback). All streams are pure functions of the step index,
+    so `start_step` resumes in O(1)."""
     if args.data_path:
-        if not token_lm:
+        if fam.data_kind != "lm":
             raise ValueError(
-                "--data_path provides a token LM stream; family %r needs its own "
-                "input pipeline (synthetic fallback runs without --data_path)"
-                % type(cfg).__name__
+                "--data_path provides a token LM stream; family %r (data_kind=%s) "
+                "needs its own input pipeline (synthetic fallback runs without "
+                "--data_path)" % (fam.name, fam.data_kind)
             )
         from galvatron_tpu.data.dataset import gpt_train_iterator
 
         return gpt_train_iterator(
-            args.data_path, hp, seq_len=cfg.max_seq_len, seed=args.seed
+            args.data_path, hp, seq_len=cfg.max_seq_len, seed=args.seed,
+            start_step=start_step,
         )
-    if getattr(cfg, "input_type", "tokens") == "patches":
+    if fam.data_kind == "vision":
         from galvatron_tpu.runtime.dataloader import get_vision_train_iterator
 
         return get_vision_train_iterator(
-            hp, cfg.image_size, cfg.num_channels, cfg.num_classes, seed=args.seed
+            hp, cfg.image_size, cfg.num_channels, cfg.num_classes, seed=args.seed,
+            start_step=start_step,
         )
-    if hasattr(cfg, "num_enc_layers"):  # encoder-decoder (t5)
+    if fam.data_kind == "seq2seq":
         from galvatron_tpu.runtime.dataloader import get_seq2seq_train_iterator
 
         return get_seq2seq_train_iterator(
-            hp, cfg.vocab_size, cfg.max_seq_len, cfg.max_seq_len, seed=args.seed
+            hp, cfg.vocab_size, cfg.max_seq_len, cfg.max_seq_len, seed=args.seed,
+            start_step=start_step,
         )
-    return get_train_iterator(hp, cfg.vocab_size, cfg.max_seq_len, seed=args.seed)
+    return get_train_iterator(hp, cfg.vocab_size, cfg.max_seq_len, seed=args.seed,
+                              start_step=start_step)
 
 
 def train(args) -> dict:
@@ -102,12 +107,9 @@ def train(args) -> dict:
             print("resumed from %s at iteration %d" % (args.load, start_iter))
 
     step_fn = model.make_train_step(tx)
-    data_iter = build_data_iterator(args, cfg, hp)
-    # deterministic resume: the stream must continue where the saved run
-    # stopped (the reference keeps Megatron dataset cursors in the optimizer
-    # checkpoint; here streams are stateless functions of the step index)
-    for _ in range(start_iter):
-        next(data_iter)
+    # deterministic resume: streams are stateless functions of the step index
+    # (the reference keeps Megatron dataset cursors in the optimizer checkpoint)
+    data_iter = build_data_iterator(args, fam, cfg, hp, start_step=start_iter)
     prof = RuntimeProfiler(
         warmup=min(2, max(args.train_iters - 1, 0)),
         rank=jax.process_index(),

@@ -1,0 +1,15 @@
+from galvatron_tpu.data.dataset import (
+    GPTDataset,
+    IndexedDataset,
+    build_sample_idx,
+    gpt_train_iterator,
+    write_indexed_dataset,
+)
+
+__all__ = [
+    "GPTDataset",
+    "IndexedDataset",
+    "build_sample_idx",
+    "gpt_train_iterator",
+    "write_indexed_dataset",
+]

@@ -1,0 +1,58 @@
+// Native sample-index builder for the indexed GPT dataset.
+//
+// TPU-era equivalent of the reference's vendored Megatron dataset helper
+// (site_package/megatron/core/datasets/helpers.cpp: build_sample_idx), which
+// the reference compiles at runtime (core/runtime/dataloader.py:12-20). Same
+// contract: walk the (epoch-repeated, shuffled) document order and emit, for
+// every training sample, the (document-index position, within-document offset)
+// where the sample's seq_len+1 token window starts. The walk is O(tokens) and
+// dominates dataset startup for billion-token corpora — the reason both the
+// reference and this build keep it native.
+//
+// Built by the Makefile next to this file into libindex_helpers.so and loaded
+// via ctypes (galvatron_tpu/data/dataset.py); a numpy fallback covers
+// environments without a toolchain.
+
+#include <cstdint>
+
+extern "C" {
+
+// doc_lens:  token count per document id                      [n_docs]
+// doc_idx:   document ids in epoch-shuffled traversal order   [n_doc_idx]
+// sample_idx: out, (n_samples+1) rows of (doc_idx_pos, offset) [2*(n_samples+1)]
+// Returns the number of samples actually emitted (<= n_samples).
+int64_t build_sample_idx(const int32_t* doc_lens,
+                         const int32_t* doc_idx,
+                         int64_t n_doc_idx,
+                         int64_t seq_len,
+                         int64_t n_samples,
+                         int64_t* sample_idx) {
+    int64_t sample = 0;
+    int64_t pos = 0;      // position in doc_idx
+    int64_t offset = 0;   // token offset within doc_idx[pos]
+    sample_idx[0] = pos;
+    sample_idx[1] = offset;
+    while (sample < n_samples && pos < n_doc_idx) {
+        // advance seq_len tokens (sample windows overlap by 1 token: the
+        // language-model target shift, matching Megatron's sample walk)
+        int64_t remaining = seq_len;
+        while (remaining > 0 && pos < n_doc_idx) {
+            int64_t doc_left = (int64_t)doc_lens[doc_idx[pos]] - offset;
+            if (doc_left > remaining) {
+                offset += remaining;
+                remaining = 0;
+            } else {
+                remaining -= doc_left;
+                ++pos;
+                offset = 0;
+            }
+        }
+        if (remaining > 0) break;  // ran out of tokens
+        ++sample;
+        sample_idx[2 * sample] = pos;
+        sample_idx[2 * sample + 1] = offset;
+    }
+    return sample;
+}
+
+}  // extern "C"

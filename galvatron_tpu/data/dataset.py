@@ -1,0 +1,218 @@
+"""Indexed GPT dataset: memmapped token binaries + native sample-index builder.
+
+TPU-native replacement for the reference's Megatron dataset stack
+(site_package/megatron/core/datasets/: IndexedDataset, GPTDataset,
+BlendedMegatronDatasetBuilder; glued in core/runtime/dataloader.py:4-20 and
+models/gpt_hf/dataloader.py). Same three-index design:
+
+  doc_idx    — document ids repeated per epoch, shuffled (epoch-wise);
+  sample_idx — per sample, the (doc_idx position, token offset) where its
+               seq_len+1 window starts (NATIVE: data/csrc/index_helpers.cpp,
+               the helpers.cpp analogue);
+  shuffle_idx— permutation of samples.
+
+All three are pure functions of (corpus, seq_len, seed, epoch count), so a
+resumed run rebuilds identical indices and the stream continues byte-for-byte
+— the determinism-across-resume property called out in SURVEY.md §7.
+
+On-disk format (our own, simpler than Megatron's .bin/.idx pair):
+  <path>.bin — flat int32 token stream
+  <path>.idx.npy — int64 document boundary offsets [n_docs + 1]
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from galvatron_tpu.config.strategy import HybridParallelConfig
+from galvatron_tpu.runtime.dataloader import prepare_batch
+
+_CSRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "csrc")
+_LIB_PATH = os.path.join(_CSRC, "libindex_helpers.so")
+_lib = None
+
+
+def _load_helpers():
+    """Load (building if needed) the native index helper; None if unavailable."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    try:
+        subprocess.run(["make", "-C", _CSRC, "-s"], check=True, capture_output=True, timeout=120)
+    except Exception:
+        if not os.path.exists(_LIB_PATH):
+            return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    lib.build_sample_idx.restype = ctypes.c_int64
+    lib.build_sample_idx.argtypes = [
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    _lib = lib
+    return _lib
+
+
+def _build_sample_idx_py(doc_lens, doc_idx, seq_len, n_samples) -> np.ndarray:
+    """Numpy fallback, same contract as the C++ helper."""
+    out = np.zeros((n_samples + 1, 2), np.int64)
+    pos, offset, sample = 0, 0, 0
+    n = len(doc_idx)
+    while sample < n_samples and pos < n:
+        remaining = seq_len
+        while remaining > 0 and pos < n:
+            doc_left = int(doc_lens[doc_idx[pos]]) - offset
+            if doc_left > remaining:
+                offset += remaining
+                remaining = 0
+            else:
+                remaining -= doc_left
+                pos += 1
+                offset = 0
+        if remaining > 0:
+            break
+        sample += 1
+        out[sample] = (pos, offset)
+    return out[: sample + 1]
+
+
+def build_sample_idx(doc_lens: np.ndarray, doc_idx: np.ndarray, seq_len: int,
+                     n_samples: int) -> np.ndarray:
+    """(n_emitted+1, 2) array of (doc_idx position, offset) boundaries."""
+    lib = _load_helpers()
+    doc_lens = np.ascontiguousarray(doc_lens, np.int32)
+    doc_idx = np.ascontiguousarray(doc_idx, np.int32)
+    if lib is None:
+        return _build_sample_idx_py(doc_lens, doc_idx, seq_len, n_samples)
+    out = np.zeros((n_samples + 1, 2), np.int64)
+    emitted = lib.build_sample_idx(
+        doc_lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        doc_idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        len(doc_idx), seq_len, n_samples,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    return out[: emitted + 1]
+
+
+# ------------------------------------------------------------------ on disk
+def write_indexed_dataset(path: str, documents: Sequence[Sequence[int]]) -> None:
+    """Write documents (token id lists) as <path>.bin + <path>.idx.npy."""
+    offsets = np.zeros(len(documents) + 1, np.int64)
+    for i, d in enumerate(documents):
+        offsets[i + 1] = offsets[i] + len(d)
+    tokens = np.concatenate([np.asarray(d, np.int32) for d in documents]) if documents else np.zeros(0, np.int32)
+    tokens.tofile(path + ".bin")
+    np.save(path + ".idx.npy", offsets)
+
+
+class IndexedDataset:
+    """Memmapped flat token stream with document boundaries."""
+
+    def __init__(self, path: str):
+        bin_path, idx_path = path + ".bin", path + ".idx.npy"
+        if not os.path.exists(bin_path) or not os.path.exists(idx_path):
+            raise FileNotFoundError(
+                "indexed dataset %r needs %s and %s (write_indexed_dataset builds them)"
+                % (path, bin_path, idx_path)
+            )
+        self.tokens = np.memmap(bin_path, dtype=np.int32, mode="r")
+        self.offsets = np.load(idx_path)
+
+    @property
+    def n_docs(self) -> int:
+        return len(self.offsets) - 1
+
+    @property
+    def doc_lens(self) -> np.ndarray:
+        return (self.offsets[1:] - self.offsets[:-1]).astype(np.int32)
+
+    def doc(self, i: int) -> np.ndarray:
+        return self.tokens[self.offsets[i] : self.offsets[i + 1]]
+
+
+class GPTDataset:
+    """Sampled LM windows over an IndexedDataset (Megatron GPTDataset
+    semantics: epoch-shuffled documents, overlapping seq_len+1 windows,
+    sample-level shuffle)."""
+
+    def __init__(self, indexed: IndexedDataset, seq_len: int, n_samples: int,
+                 seed: int = 1234):
+        self.indexed = indexed
+        self.seq_len = seq_len
+        self.seed = seed
+        doc_lens = indexed.doc_lens
+        total_tokens = int(doc_lens.sum())
+        if total_tokens <= seq_len:
+            raise ValueError(
+                "corpus has %d tokens; need > seq_len=%d" % (total_tokens, seq_len)
+            )
+        samples_per_epoch = max((total_tokens - 1) // seq_len, 1)
+        n_epochs = (n_samples + samples_per_epoch - 1) // samples_per_epoch + 1
+        rng = np.random.RandomState(seed)
+        doc_idx = np.concatenate([
+            rng.permutation(indexed.n_docs).astype(np.int32) for _ in range(n_epochs)
+        ])
+        self.sample_idx = build_sample_idx(doc_lens, doc_idx, seq_len, n_samples)
+        self.doc_idx = doc_idx
+        n_avail = len(self.sample_idx) - 1
+        self.shuffle_idx = np.random.RandomState(seed + 1).permutation(n_avail)
+        self.n_samples = n_avail
+
+    def __len__(self) -> int:
+        return self.n_samples
+
+    def __getitem__(self, i: int) -> np.ndarray:
+        """seq_len+1 tokens (inputs + shifted target)."""
+        i = int(self.shuffle_idx[i % self.n_samples])
+        (p0, o0), (p1, o1) = self.sample_idx[i], self.sample_idx[i + 1]
+        idx = self.indexed
+        if p0 == p1:
+            chunk = idx.doc(self.doc_idx[p0])[o0 : o1 + 1]
+            parts = [chunk]
+        else:
+            parts = [idx.doc(self.doc_idx[p0])[o0:]]
+            for p in range(p0 + 1, p1):
+                parts.append(idx.doc(self.doc_idx[p]))
+            parts.append(idx.doc(self.doc_idx[p1])[: o1 + 1])
+        out = np.concatenate(parts)
+        # the +1 target token may fall exactly on a boundary the walk did not
+        # include (end of corpus walk); pad deterministically if so
+        if len(out) < self.seq_len + 1:
+            out = np.concatenate([out, np.zeros(self.seq_len + 1 - len(out), np.int32)])
+        return out[: self.seq_len + 1]
+
+
+def gpt_train_iterator(
+    data_path: str,
+    hp: HybridParallelConfig,
+    seq_len: int,
+    seed: int = 1234,
+    n_samples: Optional[int] = None,
+    start_step: int = 0,
+) -> Iterator[Dict[str, jnp.ndarray]]:
+    """Deterministic batch stream for the train driver (--data_path). Batch
+    content is a pure function of the step index, so resume passes
+    `start_step` (O(1) skip)."""
+    ds = GPTDataset(
+        IndexedDataset(data_path), seq_len,
+        n_samples or 1_000_000, seed=seed,
+    )
+    step = start_step
+    while True:
+        rows = [ds[step * hp.global_bsz + b] for b in range(hp.global_bsz)]
+        window = np.stack(rows)
+        yield prepare_batch(hp, window[:, :-1], labels=window[:, 1:])
+        step += 1

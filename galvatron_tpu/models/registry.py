@@ -30,6 +30,9 @@ class ModelFamily:
     # HybridParallelModel; used by families whose param tree / forward differ
     # from the generic decoder stack (t5, swin)
     build: Optional[Callable] = None
+    # which input pipeline the train driver wires up: "lm" (token stream),
+    # "seq2seq" (enc+dec token streams), "vision" (pixels/labels)
+    data_kind: str = "lm"
 
 
 _REGISTRY: Dict[str, ModelFamily] = {}

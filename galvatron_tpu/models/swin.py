@@ -13,9 +13,9 @@ numpy at trace time."""
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from functools import partial
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -26,7 +26,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from galvatron_tpu.config.strategy import HybridParallelConfig
 from galvatron_tpu.ops.norms import layer_norm
 from galvatron_tpu.parallel import spec as S
-from galvatron_tpu.parallel.mesh import LayerAxes, layer_axes, vocab_axes
+from galvatron_tpu.parallel.mesh import LayerAxes, layer_axes
 
 Params = Dict[str, Any]
 
@@ -54,6 +54,21 @@ class SwinConfig:
     compute_dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     init_std: float = 0.02
+
+    def __post_init__(self):
+        if self.image_size % self.patch_size != 0:
+            raise ValueError(
+                "image_size %d not divisible by patch_size %d" % (self.image_size, self.patch_size)
+            )
+        for s in range(len(self.depths)):
+            res = self.stage_resolution(s)
+            w = min(self.window, res)
+            if res % w != 0:
+                raise ValueError(
+                    "stage %d resolution %d not divisible by window %d (HF pads; "
+                    "pick image_size/patch_size/window so every stage tiles)"
+                    % (s, res, w)
+                )
 
     @property
     def num_layers(self) -> int:
@@ -343,7 +358,6 @@ def block_param_specs(cfg: SwinConfig, stage: int, ax: LayerAxes) -> Params:
 
 
 def swin_param_specs(cfg: SwinConfig, hp: HybridParallelConfig) -> Params:
-    vax = vocab_axes(hp)
     r1 = P(None)
     specs: Params = {
         "embed": {
@@ -365,7 +379,7 @@ def swin_param_specs(cfg: SwinConfig, hp: HybridParallelConfig) -> Params:
 
 
 # ============================================================ HF conversion
-from galvatron_tpu.models.hf_utils import to_np as _np
+from galvatron_tpu.models.hf_utils import stack_qkv, to_np as _np
 
 
 def convert_hf_swin(state_dict: Dict[str, Any], cfg: SwinConfig) -> Params:
@@ -404,10 +418,7 @@ def convert_hf_swin(state_dict: Dict[str, Any], cfg: SwinConfig) -> Params:
         nh = cfg.num_heads[stage]
         hd = c // nh
         pre = "swin.encoder.layers.%d.blocks.%d." % (stage, d)
-        qk, bk = [], []
-        for role in ("query", "key", "value"):
-            qk.append(g(pre + "attention.self.%s.weight" % role).T.reshape(c, nh, hd))
-            bk.append(g(pre + "attention.self.%s.bias" % role).reshape(nh, hd))
+        qkv_k, qkv_b = stack_qkv(state_dict, pre + "attention.self.", c, nh, hd)
         params["blocks"].append(
             {
                 "ln1": {
@@ -419,8 +430,8 @@ def convert_hf_swin(state_dict: Dict[str, Any], cfg: SwinConfig) -> Params:
                     "bias": jnp.asarray(g(pre + "layernorm_after.bias")),
                 },
                 "wqkv": {
-                    "kernel": jnp.asarray(np.stack(qk, axis=1)),
-                    "bias": jnp.asarray(np.stack(bk, axis=0)),
+                    "kernel": jnp.asarray(qkv_k),
+                    "bias": jnp.asarray(qkv_b),
                 },
                 "wo": {
                     "kernel": jnp.asarray(g(pre + "attention.output.dense.weight").T),
@@ -491,6 +502,7 @@ def _register():
             config_fn=swin_config,
             meta_configs=META_CONFIGS,
             default_size="swin-tiny",
+            data_kind="vision",
             convert_from_hf=convert_hf_swin,
             config_from_hf=swin_config_from_hf,
             build=construct_swin_model,

@@ -487,6 +487,7 @@ def _register():
             config_fn=t5_config,
             meta_configs=META_CONFIGS,
             default_size="t5-base",
+            data_kind="seq2seq",
             convert_from_hf=convert_hf_t5,
             config_from_hf=t5_config_from_hf,
             layer_types=2,

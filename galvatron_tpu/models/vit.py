@@ -140,6 +140,7 @@ def _register():
             config_fn=vit_config,
             meta_configs=META_CONFIGS,
             default_size="vit-base",
+            data_kind="vision",
             convert_from_hf=convert_hf_vit,
             config_from_hf=vit_config_from_hf,
         )

@@ -67,42 +67,49 @@ class RandomTextDataset:
         rng = np.random.RandomState(self.seed + step % max(self.size, 1))
         return rng.randint(0, self.vocab_size, (batch_size, self.seq_len))
 
-    def iterator(self, hp: HybridParallelConfig) -> Iterator[Dict[str, jnp.ndarray]]:
-        step = 0
+    def iterator(self, hp: HybridParallelConfig, start_step: int = 0) -> Iterator[Dict[str, jnp.ndarray]]:
+        step = start_step
         while True:
             yield prepare_batch(hp, self.batch(step, hp.global_bsz))
             step += 1
 
 
 def get_train_iterator(
-    hp: HybridParallelConfig, vocab_size: int, seq_len: int, seed: int = 1234
+    hp: HybridParallelConfig, vocab_size: int, seq_len: int, seed: int = 1234,
+    start_step: int = 0,
 ) -> Iterator[Dict[str, jnp.ndarray]]:
-    return RandomTextDataset(vocab_size, seq_len, seed=seed).iterator(hp)
+    """Every stream here is a pure function of the step index, so checkpoint
+    resume passes `start_step` and skips in O(1) (the reference keeps Megatron
+    dataset cursors in its checkpoint instead)."""
+    return RandomTextDataset(vocab_size, seq_len, seed=seed).iterator(hp, start_step)
 
 
 def get_seq2seq_train_iterator(
     hp: HybridParallelConfig, vocab_size: int, enc_seq_len: int, dec_seq_len: int,
-    seed: int = 1234,
+    seed: int = 1234, start_step: int = 0,
 ) -> Iterator[Dict[str, jnp.ndarray]]:
     """Synthetic encoder-decoder stream (t5: tokens/dec_tokens/labels)."""
-    step = 0
+    step = start_step
     while True:
         rng = np.random.RandomState(seed + step)
         dec = rng.randint(0, vocab_size, (hp.global_bsz, dec_seq_len))
+        loss_mask = np.ones((hp.global_bsz, dec_seq_len), np.float32)
+        loss_mask[:, -1] = 0.0  # rolled last position has no real target
         yield {
             "tokens": jnp.asarray(rng.randint(0, vocab_size, (hp.global_bsz, enc_seq_len))),
             "dec_tokens": jnp.asarray(dec),
             "labels": jnp.asarray(np.roll(dec, -1, axis=1)),
+            "loss_mask": jnp.asarray(loss_mask),
         }
         step += 1
 
 
 def get_vision_train_iterator(
     hp: HybridParallelConfig, image_size: int, num_channels: int, num_classes: int,
-    seed: int = 1234,
+    seed: int = 1234, start_step: int = 0,
 ) -> Iterator[Dict[str, jnp.ndarray]]:
     """Synthetic image-classification stream (vit/swin: pixels/labels)."""
-    step = 0
+    step = start_step
     while True:
         rng = np.random.RandomState(seed + step)
         yield {

@@ -1,0 +1,153 @@
+"""HF <-> native checkpoint converters.
+
+Counterpart of the reference's offline converter pair
+(tools/checkpoint_convert_h2g.py:6-41 and tools/checkpoint_convert_g2h.py:11-40):
+h2g splits an HF checkpoint into the native per-layer tree and writes it as an
+orbax checkpoint the train driver resumes from (iteration 0); g2h reads a
+native checkpoint back into an HF state dict. Sharding is NOT baked into the
+files — orbax/tensorstore reads any slice, so the same converted checkpoint
+serves every parallel strategy (the reference instead streams TP-sliced
+shards at init, parallel.py:79-89).
+
+CLI:
+  python -m galvatron_tpu.tools.convert_checkpoint h2g \
+      --model_type gpt --hf_path <dir|file.bin> --output_dir ckpt/
+  python -m galvatron_tpu.tools.convert_checkpoint g2h \
+      --model_type gpt --checkpoint_dir ckpt/ --output_path out.bin
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+def _load_hf_state_dict(hf_path: str) -> Dict[str, Any]:
+    """Accepts a transformers model directory, a torch .bin/.pt file, or a
+    .safetensors file."""
+    if os.path.isdir(hf_path):
+        for name in ("pytorch_model.bin", "model.safetensors"):
+            cand = os.path.join(hf_path, name)
+            if os.path.exists(cand):
+                hf_path = cand
+                break
+        else:
+            raise FileNotFoundError("no pytorch_model.bin / model.safetensors in %s" % hf_path)
+    if hf_path.endswith(".safetensors"):
+        from safetensors.numpy import load_file
+
+        return dict(load_file(hf_path))
+    import torch
+
+    return torch.load(hf_path, map_location="cpu", weights_only=True)
+
+
+def hf_to_native(
+    model_type: str,
+    hf_state_dict: Dict[str, Any],
+    hf_config=None,
+    model_size: Optional[str] = None,
+    **config_overrides,
+):
+    """Returns (cfg, params). `hf_config` (a transformers config) wins over
+    `model_size` presets."""
+    from galvatron_tpu.models.registry import get_family
+
+    fam = get_family(model_type)
+    if fam.convert_from_hf is None:
+        raise NotImplementedError("family %r has no HF converter" % model_type)
+    if hf_config is not None:
+        if fam.config_from_hf is None:
+            raise NotImplementedError("family %r cannot derive config from HF" % model_type)
+        cfg = fam.config_from_hf(hf_config, **config_overrides)
+    else:
+        cfg = fam.config_fn(model_size or fam.default_size, **config_overrides)
+    return cfg, fam.convert_from_hf(hf_state_dict, cfg)
+
+
+def native_to_hf(model_type: str, params, cfg) -> Dict[str, np.ndarray]:
+    from galvatron_tpu.models.registry import get_family
+
+    fam = get_family(model_type)
+    if fam.export_to_hf is None:
+        raise NotImplementedError("family %r has no HF exporter" % model_type)
+    return fam.export_to_hf(params, cfg)
+
+
+def convert_h2g(args) -> str:
+    from galvatron_tpu.runtime.checkpoint import save_checkpoint
+
+    sd = _load_hf_state_dict(args.hf_path)
+    hf_config = None
+    if args.hf_config_path or os.path.isdir(args.hf_path):
+        import transformers
+
+        hf_config = transformers.AutoConfig.from_pretrained(
+            args.hf_config_path or args.hf_path
+        )
+    cfg, params = hf_to_native(
+        args.model_type, sd, hf_config=hf_config, model_size=args.model_size
+    )
+    save_checkpoint(args.output_dir, 0, params, train_meta={"iteration": 0,
+                    "source": "hf", "model_type": args.model_type})
+    return args.output_dir
+
+
+def convert_g2h(args) -> str:
+    import jax
+
+    from galvatron_tpu.models.registry import get_family
+    from galvatron_tpu.runtime.checkpoint import load_checkpoint
+
+    fam = get_family(args.model_type)
+    if args.hf_config_path:
+        import transformers
+
+        cfg = fam.config_from_hf(transformers.AutoConfig.from_pretrained(args.hf_config_path))
+    else:
+        cfg = fam.config_fn(args.model_size or fam.default_size)
+    # abstract restore target from a fresh init (shapes only; no sharding)
+    if fam.name == "t5":
+        from galvatron_tpu.models.t5 import init_t5_params as init
+    elif fam.name == "swin":
+        from galvatron_tpu.models.swin import init_swin_params as init
+    else:
+        from galvatron_tpu.models.base import init_model_params as init
+    target = jax.eval_shape(lambda: init(jax.random.PRNGKey(0), cfg))
+    params, _, _ = load_checkpoint(
+        args.checkpoint_dir, args.iteration, params_target=target, hp=None
+    )
+    sd = native_to_hf(args.model_type, params, cfg)
+    import torch
+
+    torch.save({k: torch.tensor(np.asarray(v)) for k, v in sd.items()}, args.output_path)
+    return args.output_path
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser("galvatron_tpu checkpoint converter")
+    sub = p.add_subparsers(dest="direction", required=True)
+    h2g = sub.add_parser("h2g", help="HuggingFace -> native orbax checkpoint")
+    h2g.add_argument("--model_type", required=True)
+    h2g.add_argument("--model_size", default=None)
+    h2g.add_argument("--hf_path", required=True)
+    h2g.add_argument("--hf_config_path", default=None)
+    h2g.add_argument("--output_dir", required=True)
+    g2h = sub.add_parser("g2h", help="native checkpoint -> HF state dict (.bin)")
+    g2h.add_argument("--model_type", required=True)
+    g2h.add_argument("--model_size", default=None)
+    g2h.add_argument("--hf_config_path", default=None)
+    g2h.add_argument("--checkpoint_dir", required=True)
+    g2h.add_argument("--iteration", type=int, default=None)
+    g2h.add_argument("--output_path", required=True)
+    args = p.parse_args(argv)
+    out = convert_h2g(args) if args.direction == "h2g" else convert_g2h(args)
+    print("wrote %s" % out)
+    return out
+
+
+if __name__ == "__main__":
+    main()
