@@ -34,7 +34,12 @@ def profile_model(args) -> dict:
         mixed_precision=args.mixed_precision,
         config_dir=args.config_dir,
     )
-    prof = ModelProfiler(cfg, model_name=args.model_type, args=pargs)
+    if fam.layer_types > 1:
+        from galvatron_tpu.profiler.model import T5ModelProfiler
+
+        prof = T5ModelProfiler(cfg, model_name=args.model_type, args=pargs)
+    else:
+        prof = ModelProfiler(cfg, model_name=args.model_type, args=pargs)
     return prof.profile_all(write=True)
 
 

@@ -63,12 +63,21 @@ def search(args, world_size: Optional[int] = None) -> dict:
     fam, cfg = model_config_from_args(args)
     world_size = world_size or int(os.environ.get("GALVATRON_WORLD_SIZE", "8"))
     seq = cfg.max_seq_len
+    if fam.layer_types > 1:
+        # t5: encoder and decoder are independent layer types; the DP searches
+        # a strategy per layer across both (reference dynamic_programming.py:170-189)
+        layer_cfgs = [
+            {"hidden_size": cfg.hidden_size, "seq_len": seq, "layer_num": cfg.num_enc_layers},
+            {"hidden_size": cfg.hidden_size, "seq_len": seq, "layer_num": cfg.num_dec_layers},
+        ]
+    else:
+        layer_cfgs = [
+            {"hidden_size": cfg.hidden_size, "seq_len": seq, "layer_num": cfg.num_layers}
+        ]
     engine = GalvatronSearchEngine(
         search_args_from(args),
         world_size,
-        model_layer_configs=[
-            {"hidden_size": cfg.hidden_size, "seq_len": seq, "layer_num": cfg.num_layers}
-        ],
+        model_layer_configs=layer_cfgs,
         config_dir=args.config_dir,
         model_name=args.model_type,
     )

@@ -93,6 +93,7 @@ def train(args) -> dict:
     opt_state = model.init_opt_state(tx, params)
     start_iter = 0
     if args.load:
+        fresh_opt_state = opt_state
         params, opt_state, meta = ckpt.load_checkpoint(
             args.load,
             args.load_iteration,
@@ -102,6 +103,9 @@ def train(args) -> dict:
             opt_state_shardings=model.opt_state_shardings(tx, params),
             hp=hp,
         )
+        if opt_state is None:
+            # params-only checkpoint (h2g conversion): optimizer starts fresh
+            opt_state = fresh_opt_state
         start_iter = int(meta.get("iteration", 0))
         if jax.process_index() == 0:
             print("resumed from %s at iteration %d" % (args.load, start_iter))

@@ -23,6 +23,11 @@ unsharded LayerNorm copies exist — the reason the reference must measure
 per-tp is its partially-replicated SP activations). The vocab ("other")
 tables divide by vtp the same way.
 
+Multi-layer-type models plug in by subclassing: `T5ModelProfiler` overrides
+the stack builders so encoder (layertype_0) and decoder (layertype_1) are
+differenced separately (reference profiles swin/t5 per layer list,
+model_profiler.py:71-75); every profile_mode works for every subclass.
+
 Outputs match search/engine.py:set_model_profiles:
   computation_profiling_*.json {"layertype_%d": ms|[m,c], "other_time": ms}
   memory_profiling_*.json      {"layertype_%d": {"parameter_size": MB,
@@ -37,7 +42,7 @@ import os
 import time
 from dataclasses import dataclass
 from functools import partial
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, Optional, Sequence
 
 import numpy as np
 
@@ -98,30 +103,39 @@ def _compiled_peak_bytes(fn, args) -> float:
 
 
 class ModelProfiler:
-    """Profiles one model family (a TransformerConfig); multi-layer-type
-    models (T5) profile each layer type with its own config/profiler."""
+    """Profiles one model family. One instance covers every layer type of the
+    family (`layer_types`); subclasses override the `_stack_t` /
+    `_layer_param_bytes` / `_full_model` hooks."""
 
-    def __init__(self, cfg: M.TransformerConfig, model_name: str = "model",
+    layer_types = 1
+
+    def __init__(self, cfg, model_name: str = "model",
                  args: Optional[ModelProfileArgs] = None):
-        if not isinstance(cfg, M.TransformerConfig):
-            raise TypeError(
-                "ModelProfiler profiles one TransformerConfig layer type; for "
-                "multi-layer-type families (t5) profile each layer type with "
-                "its own equivalent TransformerConfig (reference "
-                "model_profiler.py:71-75 profiles swin/t5 per layer list)"
-            )
+        self._check_config(cfg)
         self.cfg = cfg
         self.model_name = model_name
         self.args = args or ModelProfileArgs()
 
-    # ------------------------------------------------------------- primitives
-    def _stack(self, n_layers: int, bsz: int, seq: int, remat: bool = False):
-        """Jitted forward over an n-layer stack (no embed/head) + its inputs."""
-        cfg = dataclasses.replace(self.cfg, num_layers=max(n_layers, 1))
-        dtype = jnp.bfloat16 if self.args.mixed_precision == "bf16" else jnp.float32
-        keys = jax.random.split(jax.random.PRNGKey(0), max(n_layers, 1))
-        layers = [M.init_layer_params(k, cfg) for k in keys[:n_layers]]
-        x = jax.random.normal(jax.random.PRNGKey(1), (bsz, seq, cfg.hidden_size), dtype)
+    def _check_config(self, cfg):
+        if not isinstance(cfg, M.TransformerConfig):
+            raise TypeError(
+                "ModelProfiler profiles TransformerConfig families; t5 uses "
+                "T5ModelProfiler (two layer types, reference "
+                "model_profiler.py:71-75)"
+            )
+
+    @property
+    def _dtype(self):
+        return jnp.bfloat16 if self.args.mixed_precision == "bf16" else jnp.float32
+
+    # ------------------------------------------------- overridable primitives
+    def _stack_t(self, t: int, n: int, bsz: int, seq: int, remat: bool = False):
+        """Jitted forward over an n-layer stack of layer type `t` (no
+        embed/head): returns (fwd, layers, extra_args_tuple)."""
+        cfg = dataclasses.replace(self.cfg, num_layers=max(n, 1))
+        keys = jax.random.split(jax.random.PRNGKey(0), max(n, 1))
+        layers = [M.init_layer_params(k, cfg) for k in keys[:n]]
+        x = jax.random.normal(jax.random.PRNGKey(1), (bsz, seq, cfg.hidden_size), self._dtype)
         positions = jnp.broadcast_to(jnp.arange(seq), (bsz, seq))
 
         def fwd(layers, x):
@@ -131,10 +145,17 @@ class ModelProfiler:
                 x = f(lp, x, positions)
             return jnp.sum(x.astype(jnp.float32))
 
-        return fwd, layers, x
+        return fwd, layers, (x,)
+
+    def _layer_param_bytes(self, t: int) -> int:
+        return _tree_bytes(M.init_layer_params(jax.random.PRNGKey(0), self.cfg))
 
     def _full_model(self, n_layers: int, bsz: int, seq: int):
-        cfg = dataclasses.replace(self.cfg, num_layers=max(n_layers, 1), max_seq_len=max(seq, self.cfg.max_seq_len))
+        """(loss_fn, params, batch) for the whole tiny model — used for the
+        'other' (embed/head/loss) time and memory tables."""
+        cfg = dataclasses.replace(
+            self.cfg, num_layers=max(n_layers, 1), max_seq_len=max(seq, self.cfg.max_seq_len)
+        )
         params = M.init_model_params(jax.random.PRNGKey(0), cfg)
         params["layers"] = params["layers"][:n_layers]
         if cfg.input_type == "patches":
@@ -155,69 +176,37 @@ class ModelProfiler:
             loss = lambda p, b: M.lm_loss_fn(p, b, cfg)
         return loss, params, batch
 
-    # ------------------------------------------------------------ computation
-    def _fwd_ms_per_layer_per_sample(self, bsz: int, seq: int) -> float:
+    def _other_model_state_tables(self, bsz: int, seq: int, tps: Sequence[int]):
+        """(embed_mb, head_mb, rest_mb, act_total_mb) for the 'other' tables."""
+        loss, params, batch = self._full_model(0, bsz, seq)
+        embed_mb = _tree_bytes(params["embed"]) / MB
+        if getattr(self.cfg, "head_type", "lm") in ("lm", "mlm") and self.cfg.tie_embeddings:
+            head_mb = embed_mb + _tree_bytes(params.get("head", {})) / MB
+        else:
+            head_mb = (_tree_bytes(params.get("lm_head", {})) + _tree_bytes(params.get("head", {}))) / MB
+        rest_mb = _tree_bytes(params.get("final_norm", {})) / MB
+        act_total = _compiled_peak_bytes(lambda p, b: jax.grad(loss)(p, b), (params, batch))
+        act_total = max(act_total - 2 * _tree_bytes(params), 1024.0) / MB
+        return embed_mb, head_mb, rest_mb, act_total
+
+    # ----------------------------------------------------- shared differencing
+    def _fwd_ms(self, t: int, bsz: int, seq: int) -> float:
         a = self.args
         lo, hi = a.layernum_min, a.layernum_max
-        f_lo, l_lo, x = self._stack(lo, bsz, seq)
-        t_lo = _walltime(jax.jit(f_lo), (l_lo, x), a.warmup, a.iters)
-        f_hi, l_hi, x = self._stack(hi, bsz, seq)
-        t_hi = _walltime(jax.jit(f_hi), (l_hi, x), a.warmup, a.iters)
+        f_lo, l_lo, xs = self._stack_t(t, lo, bsz, seq)
+        t_lo = _walltime(jax.jit(f_lo), (l_lo,) + xs, a.warmup, a.iters)
+        f_hi, l_hi, xs = self._stack_t(t, hi, bsz, seq)
+        t_hi = _walltime(jax.jit(f_hi), (l_hi,) + xs, a.warmup, a.iters)
         return max((t_hi - t_lo) / (hi - lo) / bsz * 1e3, 1e-6)
 
-    def _other_ms_per_sample(self, bsz: int, seq: int, per_layer_ms: float) -> float:
-        """Embedding + head + loss time: full tiny model minus its layers'
-        share (reference separates this as 'other_time')."""
-        a = self.args
-        loss, params, batch = self._full_model(a.layernum_min, bsz, seq)
-        t = _walltime(jax.jit(loss), (params, batch), a.warmup, a.iters)
-        return max(t / bsz * 1e3 - a.layernum_min * per_layer_ms, 1e-6)
-
-    def profile_computation(self) -> Dict:
-        """time_config for the search engine. profile_mode:
-        - static: one scalar at (profile_batch_size, seq);
-        - batch: linear fit [m, c] of per-layer total ms vs batch size
-          (reference fits with scipy at search time, search_engine.py:119-163
-          — here the fit happens at profile time, same curve);
-        - sequence: quadratic sweep over seq; stored under "seqlen%d" keys plus
-          the fit evaluated at the target seq as the headline scalar."""
-        a = self.args
-        seq = a.profile_seq_length or self.cfg.max_seq_len
-        out: Dict = {}
-        if a.profile_mode == "batch":
-            bszs = list(range(a.profile_min_batch_size, a.profile_max_batch_size + 1, a.batch_size_step))
-            totals = [self._fwd_ms_per_layer_per_sample(b, seq) * b for b in bszs]
-            m, c = np.polyfit(np.asarray(bszs, np.float64), np.asarray(totals, np.float64), 1)
-            # time is monotone in batch; clamp fit noise so a noisy sweep can
-            # never feed the search a negative marginal cost
-            out["layertype_0"] = [float(max(m, 0.0)), float(max(c, 0.0))]
-            per_layer_ref = totals[-1] / bszs[-1]
-            out["other_time"] = self._other_ms_per_sample(bszs[-1], seq, per_layer_ref)
-        elif a.profile_mode == "sequence":
-            seqs = list(range(a.profile_min_seq_length, a.profile_max_seq_length + 1, a.seq_length_step))
-            per_seq = {s: self._fwd_ms_per_layer_per_sample(a.profile_batch_size, s) for s in seqs}
-            for s, v in per_seq.items():
-                out["layertype_0_seqlen%d" % s] = v
-            coef = np.polyfit(np.asarray(seqs, np.float64), np.asarray(list(per_seq.values())), 2)
-            out["layertype_0_seq_popt"] = [float(v) for v in coef]
-            out["layertype_0"] = float(np.polyval(coef, seq))
-            out["other_time"] = self._other_ms_per_sample(a.profile_batch_size, seq, out["layertype_0"])
-        else:
-            per_layer = self._fwd_ms_per_layer_per_sample(a.profile_batch_size, seq)
-            out["layertype_0"] = per_layer
-            out["other_time"] = self._other_ms_per_sample(a.profile_batch_size, seq, per_layer)
-        return out
-
-    # ----------------------------------------------------------------- memory
-    def _act_bytes_per_sample(self, bsz: int, seq: int, remat: bool) -> float:
+    def _act_bytes(self, t: int, bsz: int, seq: int, remat: bool) -> float:
         """Layer-differenced fwd+bwd working set per layer per sample."""
         a = self.args
         lo, hi = a.layernum_min, a.layernum_max
 
         def grad_prog(n):
-            fwd, layers, x = self._stack(n, bsz, seq, remat=remat)
-            g = lambda layers, x: jax.grad(fwd)(layers, x)
-            return g, (layers, x)
+            fwd, layers, xs = self._stack_t(t, n, bsz, seq, remat=remat)
+            return (lambda layers, *xs: jax.grad(fwd)(layers, *xs)), (layers,) + xs
 
         g_lo, args_lo = grad_prog(lo)
         g_hi, args_hi = grad_prog(hi)
@@ -229,39 +218,54 @@ class ModelProfiler:
         per_layer = (b_hi - b_lo - 2 * extra_params) / (hi - lo)
         return max(per_layer / bsz, 1024.0)
 
-    def _vocab_tables(self, bsz: int, seq: int, tps: Sequence[int]):
-        """'Other' (embed/cls) model-state and activation tables per vtp.
-        model_states = 4x params (param+grad+adam moments, fp32 master), the
-        same convention MemoryCostModel applies to layer parameter_size."""
-        loss, params, batch = self._full_model(0, bsz, seq)
-        embed_mb = _tree_bytes(params["embed"]) / MB
-        if self.cfg.head_type in ("lm", "mlm") and self.cfg.tie_embeddings:
-            head_mb = embed_mb + _tree_bytes(params.get("head", {})) / MB
-        else:
-            head_mb = (_tree_bytes(params.get("lm_head", {})) + _tree_bytes(params.get("head", {}))) / MB
-        norm_mb = _tree_bytes(params.get("final_norm", {})) / MB
-        act_total = _compiled_peak_bytes(lambda p, b: jax.grad(loss)(p, b), (params, batch))
-        act_total = max(act_total - 2 * _tree_bytes(params), 1024.0) / MB
+    def _other_ms_per_sample(self, bsz: int, seq: int, per_layer_ms_sum: float) -> float:
+        """Embedding + head + loss time: full tiny model minus its layers'
+        share (reference separates this as 'other_time')."""
+        a = self.args
+        loss, params, batch = self._full_model(a.layernum_min, bsz, seq)
+        t = _walltime(jax.jit(loss), (params, batch), a.warmup, a.iters)
+        return max(t / bsz * 1e3 - a.layernum_min * per_layer_ms_sum, 1e-6)
 
-        def per_tp(x):
-            return {t: round(x / t, 3) for t in tps}
+    # ------------------------------------------------------------ computation
+    def profile_computation(self) -> Dict:
+        """time_config for the search engine, every layer type. profile_mode:
+        - static: one scalar at (profile_batch_size, seq);
+        - batch: linear fit [m, c] of per-layer total ms vs batch size
+          (reference fits with scipy at search time, search_engine.py:119-163
+          — here the fit happens at profile time, same curve);
+        - sequence: quadratic sweep over seq; stored under "seqlen%d" keys plus
+          the fit evaluated at the target seq as the headline scalar."""
+        a = self.args
+        seq = a.profile_seq_length or self.cfg.max_seq_len
+        out: Dict = {}
+        headline = []  # per-type scalar at the target point, for other_time
+        for t in range(self.layer_types):
+            key = "layertype_%d" % t
+            if a.profile_mode == "batch":
+                bszs = list(range(a.profile_min_batch_size, a.profile_max_batch_size + 1, a.batch_size_step))
+                totals = [self._fwd_ms(t, b, seq) * b for b in bszs]
+                m, c = np.polyfit(np.asarray(bszs, np.float64), np.asarray(totals, np.float64), 1)
+                # time is monotone in batch; clamp fit noise so a noisy sweep
+                # can never feed the search a negative marginal cost
+                out[key] = [float(max(m, 0.0)), float(max(c, 0.0))]
+                headline.append(totals[-1] / bszs[-1])
+            elif a.profile_mode == "sequence":
+                seqs = list(range(a.profile_min_seq_length, a.profile_max_seq_length + 1, a.seq_length_step))
+                per_seq = {s: self._fwd_ms(t, a.profile_batch_size, s) for s in seqs}
+                for s, v in per_seq.items():
+                    out["%s_seqlen%d" % (key, s)] = v
+                coef = np.polyfit(np.asarray(seqs, np.float64), np.asarray(list(per_seq.values())), 2)
+                out["%s_seq_popt" % key] = [float(v) for v in coef]
+                out[key] = float(np.polyval(coef, seq))
+                headline.append(out[key])
+            else:
+                out[key] = self._fwd_ms(t, a.profile_batch_size, seq)
+                headline.append(out[key])
+        bsz_for_other = a.profile_max_batch_size if a.profile_mode == "batch" else a.profile_batch_size
+        out["other_time"] = self._other_ms_per_sample(bsz_for_other, seq, sum(headline))
+        return out
 
-        off = {
-            "model_states": per_tp(4 * (embed_mb + head_mb + norm_mb)),
-            "activation": {t: round(act_total / bsz / t, 3) for t in tps},
-        }
-        on = {
-            "first_stage": {
-                "model_states": per_tp(4 * embed_mb),
-                "activation": {t: round(0.5 * act_total / bsz / t, 3) for t in tps},
-            },
-            "last_stage": {
-                "model_states": per_tp(4 * (head_mb + norm_mb)),
-                "activation": {t: round(0.5 * act_total / bsz / t, 3) for t in tps},
-            },
-        }
-        return off, on
-
+    # ----------------------------------------------------------------- memory
     def profile_memory(self) -> Dict:
         a = self.args
         seq = a.profile_seq_length or self.cfg.max_seq_len
@@ -271,20 +275,39 @@ class ModelProfiler:
         while t <= a.max_tp_deg:
             tps.append(t)
             t *= 2
-        param_mb = _tree_bytes(M.init_layer_params(jax.random.PRNGKey(0), self.cfg)) / MB
-        act1 = self._act_bytes_per_sample(bsz, seq, remat=False) / MB
-        act_ckpt = self._act_bytes_per_sample(bsz, seq, remat=True) / MB
-        tp_act = {t: round(act1 / t, 3) for t in tps}
-        tp_act["checkpoint"] = round(min(act_ckpt, act1), 3)
-        other_off, other_on = self._vocab_tables(bsz, seq, tps)
-        return {
-            "layertype_0": {
+        out: Dict = {}
+        for lt in range(self.layer_types):
+            param_mb = self._layer_param_bytes(lt) / MB
+            act1 = self._act_bytes(lt, bsz, seq, remat=False) / MB
+            act_ckpt = self._act_bytes(lt, bsz, seq, remat=True) / MB
+            tp_act = {k: round(act1 / k, 3) for k in tps}
+            tp_act["checkpoint"] = round(min(act_ckpt, act1), 3)
+            out["layertype_%d" % lt] = {
                 "parameter_size": round(param_mb, 3),
                 "tp_activation_per_bsz_dict": tp_act,
-            },
-            "other_memory_pp_off": other_off,
-            "other_memory_pp_on": other_on,
+            }
+        embed_mb, head_mb, rest_mb, act_total = self._other_model_state_tables(bsz, seq, tps)
+
+        def per_tp(x):
+            return {k: round(x / k, 3) for k in tps}
+
+        # model_states = 4x params (param+grad+adam moments, fp32 master), the
+        # same convention MemoryCostModel applies to layer parameter_size
+        out["other_memory_pp_off"] = {
+            "model_states": per_tp(4 * (embed_mb + head_mb + rest_mb)),
+            "activation": {k: round(act_total / bsz / k, 3) for k in tps},
         }
+        out["other_memory_pp_on"] = {
+            "first_stage": {
+                "model_states": per_tp(4 * embed_mb),
+                "activation": {k: round(0.5 * act_total / bsz / k, 3) for k in tps},
+            },
+            "last_stage": {
+                "model_states": per_tp(4 * (head_mb + rest_mb)),
+                "activation": {k: round(0.5 * act_total / bsz / k, 3) for k in tps},
+            },
+        }
+        return out
 
     # ------------------------------------------------------------------- files
     def config_paths(self) -> Dict[str, str]:
@@ -312,3 +335,82 @@ class ModelProfiler:
             for k, v in results.items():
                 write_json_config(v, paths[k])
         return results
+
+
+class T5ModelProfiler(ModelProfiler):
+    """Two-layer-type profiler for T5 (layertype_0 = encoder, layertype_1 =
+    decoder; search consumes them via the multi-layer-type DP,
+    dynamic_programming.py:170-189). The decoder stack is differenced against
+    a FIXED encoder output so the cross-attention cost lands in the decoder
+    layer type. Every profile_mode of the base class works here."""
+
+    layer_types = 2
+
+    def _check_config(self, cfg):
+        from galvatron_tpu.models.t5 import T5Config
+
+        if not isinstance(cfg, T5Config):
+            raise TypeError("T5ModelProfiler needs a T5Config")
+
+    def _stack_t(self, t: int, n: int, bsz: int, seq: int, remat: bool = False):
+        from galvatron_tpu.models import t5 as T
+
+        cfg = dataclasses.replace(self.cfg, compute_dtype=self._dtype)
+        keys = jax.random.split(jax.random.PRNGKey(0), max(n, 1))
+        x = jax.random.normal(jax.random.PRNGKey(1), (bsz, seq, cfg.hidden_size), self._dtype)
+        table = jax.random.normal(
+            jax.random.PRNGKey(2), (cfg.rel_buckets, cfg.num_heads), jnp.float32
+        ) * 0.02
+        if t == 0:
+            layers = [T.init_enc_layer(k, cfg) for k in keys[:n]]
+            bias = T.rel_bias(table, seq, seq, cfg, bidirectional=True)
+            body = lambda lp, x: T.enc_layer_forward(lp, x, cfg, bias)
+            extra = (x,)
+
+            def fwd(layers, x):
+                for lp in layers:
+                    f = jax.checkpoint(body) if remat else body
+                    x = f(lp, x)
+                return jnp.sum(x.astype(jnp.float32))
+
+            return fwd, layers, extra
+        layers = [T.init_dec_layer(k, cfg) for k in keys[:n]]
+        bias = T.rel_bias(table, seq, seq, cfg, bidirectional=False)
+        enc_out = jax.random.normal(jax.random.PRNGKey(3), (bsz, seq, cfg.hidden_size), self._dtype)
+        body = lambda lp, x: T.dec_layer_forward(lp, x, enc_out, cfg, bias)
+
+        def fwd(layers, x):
+            for lp in layers:
+                f = jax.checkpoint(body) if remat else body
+                x = f(lp, x)
+            return jnp.sum(x.astype(jnp.float32))
+
+        return fwd, layers, (x,)
+
+    def _layer_param_bytes(self, t: int) -> int:
+        from galvatron_tpu.models import t5 as T
+
+        init = T.init_enc_layer if t == 0 else T.init_dec_layer
+        return _tree_bytes(init(jax.random.PRNGKey(0), self.cfg))
+
+    def _full_model(self, n_layers: int, bsz: int, seq: int):
+        from galvatron_tpu.models import t5 as T
+
+        cfg = dataclasses.replace(
+            self.cfg, num_enc_layers=n_layers, num_dec_layers=n_layers,
+            compute_dtype=self._dtype,
+        )
+        params = T.init_t5_params(jax.random.PRNGKey(0), cfg)
+        enc = jax.random.randint(jax.random.PRNGKey(1), (bsz, seq), 0, cfg.vocab_size)
+        dec = jax.random.randint(jax.random.PRNGKey(2), (bsz, seq), 0, cfg.vocab_size)
+        batch = {"tokens": enc, "dec_tokens": dec, "labels": dec}
+        return (lambda p, b: T.t5_loss_fn(p, b, cfg)), params, batch
+
+    def _other_model_state_tables(self, bsz: int, seq: int, tps: Sequence[int]):
+        loss, params, batch = self._full_model(0, bsz, seq)
+        embed_mb = _tree_bytes(params["embed"]) / MB
+        rest_mb = (_tree_bytes(params) - _tree_bytes(params["embed"])) / MB
+        head_mb = embed_mb if self.cfg.tie_embeddings else _tree_bytes(params.get("lm_head", {})) / MB
+        act_total = _compiled_peak_bytes(lambda p, b: jax.grad(loss)(p, b), (params, batch))
+        act_total = max(act_total - 2 * _tree_bytes(params), 1024.0) / MB
+        return embed_mb, head_mb, rest_mb, act_total

@@ -109,17 +109,21 @@ def load_checkpoint(
                 return jax.tree.map(lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), tree)
             return _abstract_like(tree, sh)
 
+        # only request items actually present: an h2g-converted checkpoint is
+        # params-only (tools/convert_checkpoint.py) — the optimizer then starts
+        # fresh, matching the reference's HF-init path (parallel.py:79-89)
+        try:
+            present = set(dict(mgr.item_metadata(iteration).items()))
+        except Exception:
+            present = {"params", "opt_state", "train_meta"}
         items = {"params": ocp.args.StandardRestore(abstract(params_target, params_shardings))}
-        if opt_state_target is not None:
+        if opt_state_target is not None and "opt_state" in present:
             items["opt_state"] = ocp.args.StandardRestore(
                 abstract(opt_state_target, opt_state_shardings)
             )
-        items["train_meta"] = ocp.args.JsonRestore()
-        try:
-            out = mgr.restore(iteration, args=ocp.args.Composite(**items))
-        except (KeyError, FileNotFoundError):
-            del items["train_meta"]
-            out = mgr.restore(iteration, args=ocp.args.Composite(**items))
+        if "train_meta" in present:
+            items["train_meta"] = ocp.args.JsonRestore()
+        out = mgr.restore(iteration, args=ocp.args.Composite(**items))
     params = out["params"]
     opt_state = out.get("opt_state")
     meta = out.get("train_meta") or {}

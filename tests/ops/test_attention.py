@@ -106,3 +106,13 @@ def test_rope_rotation_invariants():
     s1 = jnp.einsum("bqhd,bkhd->bhqk", apply_rotary(q, pos), apply_rotary(x, pos))
     s2 = jnp.einsum("bqhd,bkhd->bhqk", apply_rotary(q, pos + 7), apply_rotary(x, pos + 7))
     np.testing.assert_allclose(np.asarray(s1), np.asarray(s2), atol=1e-3)
+
+
+def test_flash_block_sizes_divide_sequence():
+    """Every seq the auto-dispatch can route to flash (multiples of 128) must
+    get block sizes that divide it (review finding: 768 crashed the kernel)."""
+    from galvatron_tpu.ops.attention import _flash_divisor
+
+    for s in (128, 256, 384, 512, 640, 768, 896, 1024, 1152, 1536, 2048, 4096):
+        b = _flash_divisor(s)
+        assert s % b == 0 and b <= 512, (s, b)

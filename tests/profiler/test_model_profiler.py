@@ -153,3 +153,22 @@ def test_profiler_rejects_multi_layer_type_config():
 
     with _pytest.raises(TypeError, match="layer type"):
         ModelProfiler(t5_config("t5-small"))
+
+
+def test_t5_profiler_batch_mode(tmp_path):
+    """profile_mode=batch must produce [m, c] fits for BOTH t5 layer types
+    (review finding: T5 profiler silently ignored profile_mode)."""
+    from galvatron_tpu.models.t5 import t5_config
+    from galvatron_tpu.profiler.model import ModelProfileArgs, T5ModelProfiler
+
+    cfg = t5_config("t5-small", hidden_size=32, num_heads=2, head_dim=16,
+                    ffn_hidden=64, num_enc_layers=2, num_dec_layers=2,
+                    vocab_size=64, max_seq_len=16)
+    args = ModelProfileArgs(
+        profile_mode="batch", profile_min_batch_size=1, profile_max_batch_size=2,
+        profile_batch_size=2, layernum_min=1, layernum_max=2, warmup=0, iters=1,
+        max_tp_deg=2, mixed_precision="fp32", config_dir=str(tmp_path),
+    )
+    res = T5ModelProfiler(cfg, "t5", args).profile_computation()
+    for key in ("layertype_0", "layertype_1"):
+        assert isinstance(res[key], list) and len(res[key]) == 2, res[key]

@@ -90,3 +90,25 @@ def test_unknown_family_errors():
 
     with pytest.raises(KeyError, match="unknown model family"):
         hf_to_native("nope", {})
+
+
+def test_h2g_params_only_checkpoint_loads_in_train_driver(tmp_path):
+    """A converted checkpoint has no opt_state; the train driver must start
+    the optimizer fresh (review finding: restore crashed on missing item)."""
+    from galvatron_tpu.cli.train import main as train_main
+    from galvatron_tpu.tools.convert_checkpoint import main as convert_main
+
+    hf_cfg = transformers.GPT2Config(n_embd=32, n_head=2, n_layer=2,
+                                     n_positions=32, vocab_size=64)
+    hf = transformers.GPT2LMHeadModel(hf_cfg)
+    hf_dir = tmp_path / "hf"
+    hf.save_pretrained(hf_dir, safe_serialization=False)
+    ckpt = str(tmp_path / "ck")
+    convert_main(["h2g", "--model_type", "gpt", "--hf_path", str(hf_dir),
+                  "--output_dir", ckpt])
+    s = train_main(["--model_type", "gpt", "--set_model_config_manually", "1",
+                    "--hidden_size", "32", "--num_attention_heads", "2",
+                    "--num_layers", "2", "--vocab_size", "64", "--seq_length", "32",
+                    "--global_train_batch_size", "8", "--train_iters", "2",
+                    "--lr", "1e-3", "--mixed_precision", "fp32", "--load", ckpt])
+    assert len(s["losses"]) == 2
