@@ -120,6 +120,9 @@ def _add_hardware_args(p: argparse.ArgumentParser):
 
 def _add_search_args(p: argparse.ArgumentParser):
     g = p.add_argument_group("search")
+    g.add_argument("--profile_seq_length", type=int, default=None,
+                   help="seq length the profiling tables were written at "
+                        "(must match --profile_seq_length of the profile run)")
     g.add_argument("--memory_constraint", type=float, default=16.0, help="HBM budget per chip, GB")
     g.add_argument("--search_space", type=str, default="full",
                    choices=("full", "dp+tp", "dp+pp", "3d", "dp", "sdp", "tp", "pp"))

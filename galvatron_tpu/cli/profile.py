@@ -34,10 +34,8 @@ def profile_model(args) -> dict:
         mixed_precision=args.mixed_precision,
         config_dir=args.config_dir,
     )
-    if fam.layer_types > 1:
-        from galvatron_tpu.profiler.model import T5ModelProfiler
-
-        prof = T5ModelProfiler(cfg, model_name=args.model_type, args=pargs)
+    if fam.make_profiler is not None:
+        prof = fam.make_profiler(cfg, args.model_type, pargs)
     else:
         prof = ModelProfiler(cfg, model_name=args.model_type, args=pargs)
     return prof.profile_all(write=True)

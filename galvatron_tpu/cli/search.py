@@ -38,6 +38,7 @@ def search_args_from(args) -> SearchArgs:
         use_pipeline_costmodel=bool(args.use_pipeline_costmodel),
         mixed_precision=args.mixed_precision == "bf16",
         default_dp_type=getattr(args, "default_dp_type", "ddp"),
+        parallel_search=bool(args.parallel_search),
     )
 
 
@@ -51,28 +52,34 @@ def _hardware_paths(config_dir: str, ndev: int) -> dict:
     }
 
 
-def _model_paths(config_dir: str, cfg, model_name: str, precision: str, seq: int) -> dict:
-    tag = "%s_hidden%d_head%d_seqlen%d" % (precision, cfg.hidden_size, cfg.num_heads, seq)
-    return {
-        "computation": os.path.join(config_dir, "computation_profiling_%s_%s.json" % (tag, model_name)),
-        "memory": os.path.join(config_dir, "memory_profiling_%s_%s.json" % (tag, model_name)),
-    }
+def _model_paths(args, fam, cfg) -> dict:
+    """Profiled-table paths — derived by the same profiler code that wrote
+    them (pass --profile_seq_length here iff the profile run used it)."""
+    from galvatron_tpu.profiler.model import ModelProfileArgs, ModelProfiler
+
+    pargs = ModelProfileArgs(
+        mixed_precision=args.mixed_precision, config_dir=args.config_dir,
+        profile_seq_length=getattr(args, "profile_seq_length", None),
+    )
+    if fam.make_profiler is not None:
+        prof = fam.make_profiler(cfg, args.model_type, pargs)
+    else:
+        prof = ModelProfiler(cfg, model_name=args.model_type, args=pargs)
+    return prof.config_paths()
 
 
 def search(args, world_size: Optional[int] = None) -> dict:
     fam, cfg = model_config_from_args(args)
     world_size = world_size or int(os.environ.get("GALVATRON_WORLD_SIZE", "8"))
-    seq = cfg.max_seq_len
-    if fam.layer_types > 1:
-        # t5: encoder and decoder are independent layer types; the DP searches
-        # a strategy per layer across both (reference dynamic_programming.py:170-189)
-        layer_cfgs = [
-            {"hidden_size": cfg.hidden_size, "seq_len": seq, "layer_num": cfg.num_enc_layers},
-            {"hidden_size": cfg.hidden_size, "seq_len": seq, "layer_num": cfg.num_dec_layers},
-        ]
+    if fam.layer_configs_fn is not None:
+        # multi-layer-type families (t5 enc/dec, swin per stage): the DP
+        # searches a strategy per layer across every type
+        # (reference dynamic_programming.py:170-189)
+        layer_cfgs = fam.layer_configs_fn(cfg)
     else:
         layer_cfgs = [
-            {"hidden_size": cfg.hidden_size, "seq_len": seq, "layer_num": cfg.num_layers}
+            {"hidden_size": cfg.hidden_size, "seq_len": cfg.max_seq_len,
+             "layer_num": cfg.num_layers}
         ]
     engine = GalvatronSearchEngine(
         search_args_from(args),
@@ -81,7 +88,7 @@ def search(args, world_size: Optional[int] = None) -> dict:
         config_dir=args.config_dir,
         model_name=args.model_type,
     )
-    mp = _model_paths(args.config_dir, cfg, args.model_type, args.mixed_precision, seq)
+    mp = _model_paths(args, fam, cfg)
     engine.set_model_profiles(
         read_json_config(mp["computation"]), read_json_config(mp["memory"])
     )

@@ -488,16 +488,20 @@ def lm_loss_fn(params, batch, cfg, hp=None, mesh=None):
     return vocab_parallel_cross_entropy(logits, batch["labels"], batch.get("loss_mask"))
 
 
+def softmax_nll(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean softmax cross entropy over (B, C) logits / (B,) integer labels."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+    return jnp.mean(nll)
+
+
 def classification_loss_fn(params, batch, cfg, hp=None, mesh=None):
     """batch: dict(pixels | tokens, labels). Mean softmax CE over classes
     (reference vit/swin `Cls_` heads)."""
     inputs = batch.get("pixels", batch.get("tokens"))
     logits = model_forward(params, inputs, batch.get("positions"), cfg, hp, mesh,
                            attn_mask=batch.get("attn_mask"))
-    logits32 = logits.astype(jnp.float32)
-    logp = jax.nn.log_softmax(logits32, axis=-1)
-    nll = -jnp.take_along_axis(logp, batch["labels"][:, None], axis=-1)[:, 0]
-    return jnp.mean(nll)
+    return softmax_nll(logits, batch["labels"])
 
 
 # ============================================================== param specs

@@ -23,9 +23,6 @@ class ModelFamily:
     convert_from_hf: Optional[Callable] = None  # (state_dict, cfg) -> params
     export_to_hf: Optional[Callable] = None  # (params, cfg) -> state_dict
     config_from_hf: Optional[Callable] = None  # (hf_config, **overrides) -> cfg
-    # families whose sequence length varies per stage (swin) or with two layer
-    # types (t5) carry extra structure for the profiler/search engine:
-    layer_types: int = 1
     # optional family-specific model constructor (cfg, hp, devices=None) ->
     # HybridParallelModel; used by families whose param tree / forward differ
     # from the generic decoder stack (t5, swin)
@@ -33,6 +30,13 @@ class ModelFamily:
     # which input pipeline the train driver wires up: "lm" (token stream),
     # "seq2seq" (enc+dec token streams), "vision" (pixels/labels)
     data_kind: str = "lm"
+    # optional (cfg) -> [{"hidden_size", "seq_len", "layer_num"}, ...] for the
+    # search engine's multi-layer-type path (t5 enc/dec, swin per stage —
+    # reference layernum_listed, model_profiler.py:71-75)
+    layer_configs_fn: Optional[Callable] = None
+    # optional (cfg, model_name, args) -> profiler instance overriding the
+    # generic ModelProfiler (t5/swin)
+    make_profiler: Optional[Callable] = None
 
 
 _REGISTRY: Dict[str, ModelFamily] = {}
@@ -85,6 +89,38 @@ def _ensure_builtin():
             convert_from_hf=llama.convert_hf_llama,
             export_to_hf=getattr(llama, "export_hf_llama", None),
             config_from_hf=llama.llama_config_from_hf,
+        )
+    )
+    # flash-attention-native variants (reference gpt_fa / llama_fa,
+    # SURVEY.md §2.4): on TPU the fused-attention choice is the pallas flash
+    # kernel, so these are the same families pinned to attn_impl="flash"
+    def _fa(fn):
+        def cfg_fa(*args, **overrides):
+            overrides.setdefault("attn_impl", "flash")
+            return fn(*args, **overrides)
+
+        return cfg_fa
+
+    register(
+        ModelFamily(
+            name="gpt_fa",
+            config_fn=_fa(gpt.gpt_config),
+            meta_configs=gpt.META_CONFIGS,
+            default_size="gpt-0.3b",
+            convert_from_hf=gpt.convert_hf_gpt2,
+            export_to_hf=gpt.export_hf_gpt2,
+            config_from_hf=_fa(gpt.gpt_config_from_hf),
+        )
+    )
+    register(
+        ModelFamily(
+            name="llama_fa",
+            config_fn=_fa(llama.llama_config),
+            meta_configs=llama.META_CONFIGS,
+            default_size="llama-0.3b",
+            convert_from_hf=llama.convert_hf_llama,
+            export_to_hf=getattr(llama, "export_hf_llama", None),
+            config_from_hf=_fa(llama.llama_config_from_hf),
         )
     )
     # extended families (bert/vit/t5/swin) self-register on import

@@ -332,10 +332,10 @@ def swin_forward(
 
 
 def swin_loss_fn(params, batch, cfg: SwinConfig, hp=None, mesh=None):
-    logits = swin_forward(params, batch["pixels"], cfg, hp, mesh).astype(jnp.float32)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, batch["labels"][:, None], axis=-1)[:, 0]
-    return jnp.mean(nll)
+    from galvatron_tpu.models.base import softmax_nll
+
+    logits = swin_forward(params, batch["pixels"], cfg, hp, mesh)
+    return softmax_nll(logits, batch["labels"])
 
 
 # ============================================================== param specs
@@ -493,6 +493,25 @@ def construct_swin_model(cfg: SwinConfig, hp: HybridParallelConfig, devices=None
     )
 
 
+def _swin_layer_configs(cfg: SwinConfig):
+    """One layer type per stage, with the stage's own width and token count
+    (reference layernum_listed + per-stage seqlens, model_profiler.py:71-100)."""
+    return [
+        {
+            "hidden_size": cfg.stage_dim(s),
+            "seq_len": cfg.stage_resolution(s) ** 2,
+            "layer_num": cfg.depths[s],
+        }
+        for s in range(cfg.num_stages)
+    ]
+
+
+def _swin_profiler(cfg, model_name, args):
+    from galvatron_tpu.profiler.model import SwinModelProfiler
+
+    return SwinModelProfiler(cfg, model_name, args)
+
+
 def _register():
     from galvatron_tpu.models.registry import ModelFamily, register
 
@@ -506,6 +525,8 @@ def _register():
             convert_from_hf=convert_hf_swin,
             config_from_hf=swin_config_from_hf,
             build=construct_swin_model,
+            layer_configs_fn=_swin_layer_configs,
+            make_profiler=_swin_profiler,
         )
     )
 

@@ -478,6 +478,19 @@ def construct_t5_model(cfg: T5Config, hp: HybridParallelConfig, devices=None):
     )
 
 
+def _t5_layer_configs(cfg: T5Config):
+    return [
+        {"hidden_size": cfg.hidden_size, "seq_len": cfg.max_seq_len, "layer_num": cfg.num_enc_layers},
+        {"hidden_size": cfg.hidden_size, "seq_len": cfg.max_seq_len, "layer_num": cfg.num_dec_layers},
+    ]
+
+
+def _t5_profiler(cfg, model_name, args):
+    from galvatron_tpu.profiler.model import T5ModelProfiler
+
+    return T5ModelProfiler(cfg, model_name, args)
+
+
 def _register():
     from galvatron_tpu.models.registry import ModelFamily, register
 
@@ -490,8 +503,9 @@ def _register():
             data_kind="seq2seq",
             convert_from_hf=convert_hf_t5,
             config_from_hf=t5_config_from_hf,
-            layer_types=2,
             build=construct_t5_model,
+            layer_configs_fn=_t5_layer_configs,
+            make_profiler=_t5_profiler,
         )
     )
 

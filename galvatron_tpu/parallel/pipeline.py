@@ -34,7 +34,7 @@ composes with tp/sp/dp; cp+pp lands with the pallas ring kernel).
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -117,6 +117,7 @@ def pipeline_apply(
     cfg,
     hp: HybridParallelConfig,
     mesh: Mesh,
+    attn_bias_mb: Optional[jax.Array] = None,  # (num_mb, mb, 1, 1, S)
 ) -> jax.Array:
     """Run the scan pipeline; returns (num_mb, mb, S, H) last-stage outputs."""
     from galvatron_tpu.models.base import layer_forward
@@ -124,76 +125,93 @@ def pipeline_apply(
     pp, num_mb = hp.pp, hp.chunks
     lps = layers_per_stage(hp)
 
-    def stage_body(stage_layers: List[Params], x, pos):
+    # the mask is threaded through the scan only when present — a None here is
+    # a trace-time constant, so maskless runs keep `bias is None` inside
+    # layer_forward and the flash-attention dispatch stays eligible
+    use_bias = attn_bias_mb is not None
+
+    def stage_body(stage_layers: List[Params], x, pos, bias=None):
         for j in range(lps):
-            fwd = partial(layer_forward, cfg=cfg, mesh=None, axes=None)
+            fwd = partial(layer_forward, cfg=cfg, mesh=None, axes=None, attn_bias=bias)
             if hp.layers[j].checkpoint:
                 fwd = jax.checkpoint(fwd)
             x = fwd(stage_layers[j], x, pos)
         return x
 
-    vstage = jax.vmap(stage_body, in_axes=(0, 0, 0))
+    vstage = jax.vmap(stage_body, in_axes=(0, 0, 0, 0) if use_bias else (0, 0, 0))
 
     ax0 = layer_axes(hp, 0)
     buf_spec = P(PP_AXIS, S._ax(ax0.batch_axes), S._ax(ax0.seq_axes), None)
     pos_buf_spec = P(PP_AXIS, S._ax(ax0.batch_axes), S._ax(ax0.seq_axes))
 
     mb_shape = x_mb.shape[1:]
-    state = jnp.zeros((pp,) + mb_shape, x_mb.dtype)
-    state_pos = jnp.zeros((pp,) + positions_mb.shape[1:], positions_mb.dtype)
-
     total = num_mb + pp - 1
     pad = total - num_mb
-    xs_x = jnp.concatenate([x_mb, jnp.zeros((pad,) + mb_shape, x_mb.dtype)], 0)
-    xs_p = jnp.concatenate(
-        [positions_mb, jnp.zeros((pad,) + positions_mb.shape[1:], positions_mb.dtype)], 0
-    )
+
+    def padded(t):
+        return jnp.concatenate([t, jnp.zeros((pad,) + t.shape[1:], t.dtype)], 0)
+
+    carry0 = [jnp.zeros((pp,) + mb_shape, x_mb.dtype),
+              jnp.zeros((pp,) + positions_mb.shape[1:], positions_mb.dtype)]
+    xs = [padded(x_mb), padded(positions_mb)]
+    if use_bias:
+        carry0.append(jnp.zeros((pp,) + attn_bias_mb.shape[1:], attn_bias_mb.dtype))
+        xs.append(padded(attn_bias_mb))
 
     def tick(carry, xt):
-        state, state_pos = carry
-        inp, inp_pos = xt
         # shift previous outputs to the next stage; microbatch enters stage 0.
-        state = jnp.roll(state, 1, axis=0).at[0].set(inp)
-        state_pos = jnp.roll(state_pos, 1, axis=0).at[0].set(inp_pos)
-        state = S.constrain(state, mesh, buf_spec)
-        state_pos = S.constrain(state_pos, mesh, pos_buf_spec)
-        out = vstage(stacked_layers, state, state_pos)
+        shifted = [jnp.roll(c, 1, axis=0).at[0].set(inp) for c, inp in zip(carry, xt)]
+        shifted[0] = S.constrain(shifted[0], mesh, buf_spec)
+        shifted[1] = S.constrain(shifted[1], mesh, pos_buf_spec)
+        out = vstage(stacked_layers, *shifted)
         out = S.constrain(out, mesh, buf_spec)
-        return (out, state_pos), out[-1]
+        return [out] + shifted[1:], out[-1]
 
-    (_, _), ys = jax.lax.scan(tick, (state, state_pos), (xs_x, xs_p))
+    _, ys = jax.lax.scan(tick, carry0, tuple(xs))
     return ys[pp - 1 :]
 
 
 def make_pipelined_loss(cfg, hp: HybridParallelConfig, mesh: Mesh):
     """Loss over the pipelined model; batch is split into `chunks` microbatches
     INSIDE this function, so the train step's grad-accumulation loop must not
-    split again (model_api handles this)."""
+    split again (model_api handles this). Serves every head type of the
+    generic tree (lm / mlm / classification — the reference's per-model `Cls_`
+    stages, GPTModel_sequential.py:201-215)."""
     from galvatron_tpu.models import base as M
 
     validate_pipeline_config(hp)
     vax = vocab_axes(hp)
 
     def loss_fn(params, batch):
-        tokens, positions, labels = batch["tokens"], batch["positions"], batch["labels"]
         num_mb = hp.chunks
-        B = tokens.shape[0]
+        if cfg.input_type == "patches":
+            inputs = batch["pixels"]
+            x = M.embed_patches(params["embed"], inputs, cfg)
+            positions = jnp.zeros(x.shape[:2], jnp.int32)
+        else:
+            inputs = batch["tokens"]
+            positions = batch["positions"]
+            x = M.embed_tokens(params["embed"], inputs, positions, cfg, mesh, vax,
+                               token_type_ids=batch.get("token_type_ids"))
+        B = x.shape[0]
         mb = B // num_mb
 
-        def split(x):
-            return x.reshape((num_mb, mb) + x.shape[1:])
+        def split(t):
+            return t.reshape((num_mb, mb) + t.shape[1:])
 
-        pos_mb = split(positions)
+        bias_mb = None
+        if batch.get("attn_mask") is not None:
+            bias_mb = split(M.padding_attn_bias(batch["attn_mask"]))
         # embed all microbatches up-front (replicated across pp groups; the
         # vocab layers' own parallelism comes from vocab_tp/vocab_sp axes)
-        x = M.embed_tokens(params["embed"], tokens, positions, cfg, mesh, vax)
-        x = split(x)
-        outs = pipeline_apply(params["stages"], x, pos_mb, cfg, hp, mesh)
-        h = outs.reshape((B,) + tokens.shape[1:] + (cfg.hidden_size,))
+        outs = pipeline_apply(params["stages"], split(x), split(positions), cfg, hp, mesh,
+                              attn_bias_mb=bias_mb)
+        h = outs.reshape((B,) + x.shape[1:])
         h = S.constrain(h, mesh, S.act_spec(vax))
-        logits = M.lm_logits(params, h, cfg)
+        logits = M.model_head(params, h, cfg)
+        if cfg.head_type == "classification":
+            return M.softmax_nll(logits, batch["labels"])
         logits = S.constrain(logits, mesh, S.logits_spec(vax))
-        loss_mask = batch.get("loss_mask")
-        return M.vocab_parallel_cross_entropy(logits, labels, loss_mask)
+        return M.vocab_parallel_cross_entropy(logits, batch["labels"], batch.get("loss_mask"))
 
     return loss_fn

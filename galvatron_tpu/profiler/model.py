@@ -128,6 +128,16 @@ class ModelProfiler:
     def _dtype(self):
         return jnp.bfloat16 if self.args.mixed_precision == "bf16" else jnp.float32
 
+    @property
+    def _target_seq(self) -> int:
+        return self.args.profile_seq_length or self.cfg.max_seq_len
+
+    def _file_tag(self) -> str:
+        c = self.cfg
+        return "%s_hidden%d_head%d_seqlen%d" % (
+            self.args.mixed_precision, c.hidden_size, c.num_heads, self._target_seq
+        )
+
     # ------------------------------------------------- overridable primitives
     def _stack_t(self, t: int, n: int, bsz: int, seq: int, remat: bool = False):
         """Jitted forward over an n-layer stack of layer type `t` (no
@@ -236,7 +246,7 @@ class ModelProfiler:
         - sequence: quadratic sweep over seq; stored under "seqlen%d" keys plus
           the fit evaluated at the target seq as the headline scalar."""
         a = self.args
-        seq = a.profile_seq_length or self.cfg.max_seq_len
+        seq = self._target_seq
         out: Dict = {}
         headline = []  # per-type scalar at the target point, for other_time
         for t in range(self.layer_types):
@@ -268,7 +278,7 @@ class ModelProfiler:
     # ----------------------------------------------------------------- memory
     def profile_memory(self) -> Dict:
         a = self.args
-        seq = a.profile_seq_length or self.cfg.max_seq_len
+        seq = self._target_seq
         bsz = a.profile_batch_size
         tps = []
         t = 1
@@ -311,10 +321,7 @@ class ModelProfiler:
 
     # ------------------------------------------------------------------- files
     def config_paths(self) -> Dict[str, str]:
-        prec = self.args.mixed_precision
-        c = self.cfg
-        seq = self.args.profile_seq_length or c.max_seq_len
-        tag = "%s_hidden%d_head%d_seqlen%d" % (prec, c.hidden_size, c.num_heads, seq)
+        tag = self._file_tag()
         return {
             "computation": os.path.join(
                 self.args.config_dir, "computation_profiling_%s_%s.json" % (tag, self.model_name)
@@ -414,3 +421,88 @@ class T5ModelProfiler(ModelProfiler):
         act_total = _compiled_peak_bytes(lambda p, b: jax.grad(loss)(p, b), (params, batch))
         act_total = max(act_total - 2 * _tree_bytes(params), 1024.0) / MB
         return embed_mb, head_mb, rest_mb, act_total
+
+
+class SwinModelProfiler(ModelProfiler):
+    """Per-stage layer types for swin (reference `layernum_listed` profiling,
+    model_profiler.py:71-75, with per-stage seqlens :96-100): layertype_s is
+    stage s's block at its own resolution/width. Block differencing runs on
+    (B, res, res, C) activations; shifted blocks alternate as in the model."""
+
+    def _check_config(self, cfg):
+        from galvatron_tpu.models.swin import SwinConfig
+
+        if not isinstance(cfg, SwinConfig):
+            raise TypeError("SwinModelProfiler needs a SwinConfig")
+
+    @property
+    def _target_seq(self) -> int:
+        # each stage has its own resolution; the headline seq is the stage-0
+        # patch-grid token count
+        return self.args.profile_seq_length or self.cfg.stage_resolution(0) ** 2
+
+    def _file_tag(self) -> str:
+        c = self.cfg
+        return "%s_hidden%d_head%d_seqlen%d" % (
+            self.args.mixed_precision, c.embed_dim, c.num_heads[0], self._target_seq
+        )
+
+    @property
+    def layer_types(self):  # type: ignore[override]
+        return self.cfg.num_stages
+
+    def _stack_t(self, t: int, n: int, bsz: int, seq: int, remat: bool = False):
+        # `seq` is ignored: each stage has a fixed resolution from the config
+        from galvatron_tpu.models import swin as W
+
+        cfg = dataclasses.replace(self.cfg, compute_dtype=self._dtype)
+        res = cfg.stage_resolution(t)
+        keys = jax.random.split(jax.random.PRNGKey(0), max(n, 1))
+        layers = [W.init_block_params(k, cfg, t) for k in keys[:n]]
+        x = jax.random.normal(
+            jax.random.PRNGKey(1), (bsz, res, res, cfg.stage_dim(t)), self._dtype
+        )
+
+        def fwd(layers, x):
+            for j, lp in enumerate(layers):
+                body = partial(W.block_forward, cfg=cfg, stage=t, shift=(j % 2 == 1))
+                f = jax.checkpoint(body) if remat else body
+                x = f(lp, x)
+            return jnp.sum(x.astype(jnp.float32))
+
+        return fwd, layers, (x,)
+
+    def _layer_param_bytes(self, t: int) -> int:
+        from galvatron_tpu.models import swin as W
+
+        return _tree_bytes(W.init_block_params(jax.random.PRNGKey(0), self.cfg, t))
+
+    def _full_model(self, n_layers: int, bsz: int, seq: int):
+        from galvatron_tpu.models import swin as W
+
+        cfg = dataclasses.replace(
+            self.cfg,
+            depths=tuple(max(n_layers, 1) for _ in self.cfg.depths),
+            compute_dtype=self._dtype,
+        )
+        params = W.init_swin_params(jax.random.PRNGKey(0), cfg)
+        if n_layers == 0:
+            params["blocks"] = []
+            cfg = dataclasses.replace(cfg, depths=tuple(0 for _ in self.cfg.depths))
+        batch = {
+            "pixels": jax.random.normal(
+                jax.random.PRNGKey(1), (bsz, cfg.image_size, cfg.image_size, cfg.num_channels)
+            ),
+            "labels": jax.random.randint(jax.random.PRNGKey(2), (bsz,), 0, max(cfg.num_classes, 1)),
+        }
+        return (lambda p, b: W.swin_loss_fn(p, b, cfg)), params, batch
+
+    def _other_model_state_tables(self, bsz: int, seq: int, tps: Sequence[int]):
+        loss, params, batch = self._full_model(0, bsz, seq)
+        embed_mb = _tree_bytes(params["embed"]) / MB
+        head_mb = _tree_bytes(params["head"]) / MB
+        rest_mb = (_tree_bytes(params["merges"]) + _tree_bytes(params["final_norm"])) / MB
+        act_total = _compiled_peak_bytes(lambda p, b: jax.grad(loss)(p, b), (params, batch))
+        act_total = max(act_total - 2 * _tree_bytes(params), 1024.0) / MB
+        return embed_mb, head_mb, rest_mb, act_total
+

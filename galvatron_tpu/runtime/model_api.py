@@ -206,11 +206,6 @@ def construct_hybrid_parallel_model(
 ) -> HybridParallelModel:
     mesh = build_mesh(hp, devices)
     specs = M.model_param_specs(cfg, hp)
-    if hp.pp > 1 and cfg.head_type != "lm":
-        raise NotImplementedError(
-            "pp>1 currently supports head_type='lm' only (the scan pipeline ends "
-            "in lm_logits); mlm/classification heads run with pp=1 strategies"
-        )
     if hp.pp > 1:
         from galvatron_tpu.parallel.pipeline import make_pipelined_loss, stack_layer_specs
 

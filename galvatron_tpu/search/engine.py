@@ -65,6 +65,7 @@ class SearchArgs:
     vsp: int = -1  # -1: search both; 0/1: fixed
     mem_cache_gb: float = 0.0
     costmodel_coe: float = 1.0
+    parallel_search: bool = False  # thread-parallel outer loop (--parallel_search)
 
 
 def generate_strategies(world_size: int, args: SearchArgs) -> List[list]:
@@ -364,18 +365,36 @@ class GalvatronSearchEngine:
         chunk_opts = [a.settle_chunk] if a.settle_chunk else [1, 2, 4, 8]
         vsp_opts = [a.vsp] if a.vsp in (0, 1) else ([0, 1] if a.sp_space in ("sp", "tp+sp") else [0])
         esdp_opts = [bool(a.embed_sdp)] if a.embed_sdp in (0, 1) else [False, True]
-        for bsz in bszs:
-            for chunks in chunk_opts:
-                if bsz % chunks != 0:
-                    continue
-                for vsp in vsp_opts:
-                    for embed_sdp in esdp_opts:
-                        r = self.search_for_bsz_chunk(bsz, chunks, vsp=vsp, embed_sdp=embed_sdp)
-                        if r["strategies"] is None or not np.isfinite(r["cost"]):
-                            continue
-                        throughput = bsz / r["cost"]
-                        if throughput > best_throughput:
-                            best, best_throughput = r, throughput
+        tasks = [
+            (bsz, chunks, vsp, embed_sdp)
+            for bsz in bszs
+            for chunks in chunk_opts
+            if bsz % chunks == 0
+            for vsp in vsp_opts
+            for embed_sdp in esdp_opts
+        ]
+        if a.parallel_search and len(tasks) > 1:
+            # thread-parallel outer loop (reference --parallel_search,
+            # search_engine.py:427-475): each task is an independent DP over
+            # shared read-only tables; the C++ core releases no GIL but the
+            # numpy/C work interleaves well enough to pay off on big sweeps
+            from concurrent.futures import ThreadPoolExecutor
+
+            workers = min(len(tasks), max(2, os.cpu_count() or 2))
+            with ThreadPoolExecutor(max_workers=workers) as pool:
+                results = list(
+                    pool.map(lambda t: self.search_for_bsz_chunk(t[0], t[1], vsp=t[2], embed_sdp=t[3]), tasks)
+                )
+        else:
+            results = [
+                self.search_for_bsz_chunk(b, c, vsp=v, embed_sdp=e) for b, c, v, e in tasks
+            ]
+        for r in results:
+            if r["strategies"] is None or not np.isfinite(r["cost"]):
+                continue
+            throughput = r["bsz"] / r["cost"]
+            if throughput > best_throughput:
+                best, best_throughput = r, throughput
         self.best = best
         return best
 

@@ -46,6 +46,10 @@ STRATEGIES = {
     "cp2_ring": dict(cp=2),
     "zero3": dict(sdp=1),
     "tp2_nonconsec": dict(tp=2),
+    # ulysses composed with ring CP on the same layer (reference
+    # transformer.py:643-654): heads all-to-all over the sp axes, K/V ring
+    # rotation over the cp axes
+    "ulysses2_cp2_compose": dict(tp=2, sp=1, cp=2),
 }
 
 

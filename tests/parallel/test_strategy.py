@@ -75,3 +75,54 @@ def test_reference_format_json(tmp_path):
     assert cfg.pp == 1 and cfg.num_layers == 4
     assert cfg.dp_type(0) == "zero2"
     assert cfg.dp(0) == 8
+
+
+def test_fa_families_pin_flash_attention():
+    """gpt_fa / llama_fa (reference flash-attn-native variants) resolve to the
+    same configs with attn_impl pinned to the pallas flash kernel."""
+    from galvatron_tpu.models.registry import family_names, get_family
+
+    assert {"gpt_fa", "llama_fa"} <= set(family_names())
+    for name in ("gpt_fa", "llama_fa"):
+        fam = get_family(name)
+        cfg = fam.config_fn(fam.default_size)
+        assert cfg.attn_impl == "flash"
+    # base families stay on auto
+    assert get_family("gpt").config_fn("gpt-0.3b").attn_impl == "auto"
+
+
+def test_parallel_search_matches_serial():
+    """--parallel_search must find the same optimum as the serial loop."""
+    import numpy as np
+
+    from galvatron_tpu.search.engine import GalvatronSearchEngine, SearchArgs
+
+    def run(parallel):
+        args = SearchArgs(memory_constraint=8.0, max_tp_deg=2, max_pp_deg=1,
+                          min_bsz=8, max_bsz=16, bsz_scale=8,
+                          parallel_search=parallel)
+        eng = GalvatronSearchEngine(
+            args, 8,
+            [{"hidden_size": 64, "seq_len": 32, "layer_num": 2}],
+        )
+        eng.set_model_profiles(
+            {"layertype_0": 1.0, "other_time": 0.5},
+            {"layertype_0": {"parameter_size": 10.0,
+                             "tp_activation_per_bsz_dict": {1: 2.0, 2: 1.0, "checkpoint": 0.5}},
+             "other_memory_pp_off": {"model_states": {1: 40.0, 2: 20.0},
+                                     "activation": {1: 4.0, 2: 2.0}},
+             "other_memory_pp_on": {"first_stage": {"model_states": {1: 20.0, 2: 10.0},
+                                                    "activation": {1: 2.0, 2: 1.0}},
+                                    "last_stage": {"model_states": {1: 20.0, 2: 10.0},
+                                                   "activation": {1: 2.0, 2: 1.0}}}},
+        )
+        eng.set_hardware_profiles({"allreduce_size_8_consec_1": 100.0,
+                                   "allreduce_size_4_consec_1": 100.0,
+                                   "allreduce_size_2_consec_1": 100.0})
+        eng.initialize_search_engine()
+        return eng.parallelism_optimization()
+
+    serial, parallel = run(False), run(True)
+    assert serial is not None and parallel is not None
+    assert np.isclose(serial["cost"], parallel["cost"])
+    assert serial["bsz"] == parallel["bsz"]

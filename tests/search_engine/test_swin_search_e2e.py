@@ -1,0 +1,84 @@
+"""Swin per-stage-layer-type profile -> search -> train loop (reference
+layernum_listed profiling, model_profiler.py:71-100, and the
+multi-layer-type DP)."""
+
+import os
+
+import pytest
+
+from galvatron_tpu.utils.jsonio import write_json_config
+
+pytestmark = [pytest.mark.search_engine]
+
+
+def test_swin_profile_search_train(tmp_path, devices8):
+    d = str(tmp_path)
+    # tiny swin whose stage head counts allow tp=2 everywhere
+    size_args = ["--model_type", "swin", "--model_size", "swin-tiny"]
+    import jax.numpy as jnp
+
+    from galvatron_tpu.models.swin import swin_config
+    from galvatron_tpu.profiler.model import ModelProfileArgs, SwinModelProfiler
+
+    cfg = swin_config(
+        "swin-tiny", embed_dim=16, depths=(2, 2), num_heads=(2, 4),
+        image_size=32, patch_size=4, window=4, mlp_ratio=2.0, num_classes=10,
+        compute_dtype=jnp.float32,
+    )
+    pargs = ModelProfileArgs(
+        profile_batch_size=2, layernum_min=1, layernum_max=2, warmup=0, iters=1,
+        max_tp_deg=2, mixed_precision="bf16", config_dir=d,
+    )
+    prof = SwinModelProfiler(cfg, "swin", pargs)
+    res = prof.profile_all(write=True)
+    assert "layertype_1" in res["computation"]
+    # stage-1 blocks are wider (2x dim): more params per block
+    assert (
+        res["memory"]["layertype_1"]["parameter_size"]
+        > res["memory"]["layertype_0"]["parameter_size"]
+    )
+
+    write_json_config(
+        {"allreduce_size_8_consec_1": 100.0, "allreduce_size_4_consec_1": 100.0,
+         "allreduce_size_2_consec_1": 100.0},
+        os.path.join(d, "allreduce_bandwidth_8chips.json"),
+    )
+    write_json_config({"overlap_coe": 1.1}, os.path.join(d, "overlap_coefficient.json"))
+
+    from galvatron_tpu.models.registry import get_family
+    from galvatron_tpu.search.engine import GalvatronSearchEngine, SearchArgs
+
+    fam = get_family("swin")
+    layer_cfgs = fam.layer_configs_fn(cfg)
+    assert [lc["hidden_size"] for lc in layer_cfgs] == [16, 32]
+    assert [lc["seq_len"] for lc in layer_cfgs] == [64, 16]
+
+    engine = GalvatronSearchEngine(
+        SearchArgs(memory_constraint=8.0, max_tp_deg=2, max_pp_deg=1,
+                   settle_bsz=8, settle_chunk=1),
+        8, layer_cfgs, config_dir=d, model_name="swin",
+    )
+    engine.set_model_profiles(res["computation"], res["memory"])
+    engine.set_hardware_profiles({"allreduce_size_8_consec_1": 100.0,
+                                  "allreduce_size_4_consec_1": 100.0,
+                                  "allreduce_size_2_consec_1": 100.0})
+    engine.initialize_search_engine()
+    best = engine.parallelism_optimization()
+    assert best is not None and len(best["strategies"]) == 4
+
+    # execute the searched strategy
+    hp = engine.result_to_config(best)
+    from galvatron_tpu.models.swin import construct_swin_model
+
+    import jax
+    import numpy as np
+
+    m = construct_swin_model(cfg, hp, devices8)
+    params = m.init_params(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    batch = m.shard_batch(dict(
+        pixels=jnp.asarray(rng.randn(hp.global_bsz, 32, 32, 3).astype(np.float32)),
+        labels=jnp.asarray(rng.randint(0, 10, (hp.global_bsz,))),
+    ))
+    loss = float(jax.jit(m.loss_fn)(params, batch))
+    assert loss == loss  # finite
