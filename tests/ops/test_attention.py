@@ -114,5 +114,6 @@ def test_flash_block_sizes_divide_sequence():
     from galvatron_tpu.ops.attention import _flash_divisor
 
     for s in (128, 256, 384, 512, 640, 768, 896, 1024, 1152, 1536, 2048, 4096):
-        b = _flash_divisor(s)
-        assert s % b == 0 and b <= 512, (s, b)
+        for cap in (512, 1024):
+            b = _flash_divisor(s, cap)
+            assert s % b == 0 and b <= cap, (s, cap, b)
