@@ -22,13 +22,14 @@ collective-permutes — which also makes tied-embedding gradients (used by both
 stage 0 and the last stage) correct with no embedding-group all-reduce
 (reference grad_reduce.py:68-124).
 
-`pipeline_type="pipedream_flush"` is accepted for config compatibility; both
-schedules execute this scan pipeline (same bubble fraction (pp-1)/m as 1F1B;
-1F1B's lower activation watermark is covered by per-stage rematerialisation).
+This module is the GPipe schedule; `pipeline_type="pipedream_flush"` runs the
+true 1F1B engine in parallel/pipeline_1f1b.py (bounded activation stash,
+hand-written backward, heterogeneous per-stage strategies).
 
-Current restrictions (asserted): equal layers per stage; within-stage layer
-strategies uniform across stages; no ring-attention CP inside pp>1 (cp
-composes with tp/sp/dp; cp+pp lands with the pallas ring kernel).
+GPipe-scan restrictions (asserted): equal layers per stage; within-stage layer
+strategies — including checkpoint flags — uniform across stages (the vmapped
+body is one program; heterogeneous configs must use 1F1B); no ring-attention
+CP inside pp>1.
 """
 
 from __future__ import annotations
@@ -62,7 +63,8 @@ def validate_pipeline_config(hp: HybridParallelConfig):
         if len(strategies) != 1:
             raise ValueError(
                 "within-stage layer %d must use the same strategy on every stage "
-                "for the stacked pipeline; got %s" % (j, strategies)
+                "for the gpipe scan pipeline (use pipeline_type='pipedream_flush' "
+                "for per-stage heterogeneous strategies); got %s" % (j, strategies)
             )
     for s in hp.layers:
         if s.cp > 1:

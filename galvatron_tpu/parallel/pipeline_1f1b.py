@@ -1,0 +1,459 @@
+"""True 1F1B (pipedream-flush) pipeline schedule as one SPMD program.
+
+TPU-native re-design of the reference's 1F1B engine
+(galvatron/core/runtime/pipeline/pipeline.py:375-701 — warmup :455-495,
+steady one-forward-one-backward :512-631, cooldown :640-691, batched P2P
+:1080-1257). The reference runs per-rank Python schedules with NCCL
+send/recv; here the whole schedule — forward ticks, backward ticks, the
+bounded activation stash, and the hand-written backward — is ONE jitted
+`lax.scan` whose body enters a `shard_map` that is *manual* over the ``pp``
+mesh axis and *auto* (GSPMD) over the within-stage axes:
+
+- each device knows its stage via ``lax.axis_index('pp')`` and follows its
+  own row of a precomputed (T, pp) schedule table: classic 1F1B timing
+  ``fwd(i, s) = s + i`` during warmup (depth ``pp - s``), ``2 i + s`` in
+  steady state, ``bwd(j, s) = 2 j + 2 pp - s - 1`` — so the steady state
+  alternates one forward and one backward per stage and stage s holds at
+  most ``pp - s`` in-flight microbatches (the 1F1B activation watermark,
+  reference cost_model.py:85-97), independent of ``chunks``;
+- stage boundaries are explicit ``lax.ppermute`` sends (the analogue of the
+  reference's ``batch_isend_irecv``) — activations up, cotangents down;
+- the backward is hand-written inside the scan: each backward tick pops the
+  saved stage *input* from a ``min(pp, chunks)``-deep circular stash and
+  calls ``jax.vjp`` on the stage body (stage-granular rematerialisation —
+  the same compute budget as the reference's 1F1B with
+  ``--checkpoint_activations``), accumulating parameter gradients in a
+  carried accumulator. Nothing autodiffs *through* the scan, so no per-tick
+  residuals are saved — the live set is the stash plus one transient stage;
+- per-stage bodies are selected with ``lax.switch``, so every stage may run
+  its own layer strategies (tp/sp/fsdp/ckpt per layer — the reference's
+  layer-wise heterogeneity, hybrid_parallel_model.py:263-268) with GSPMD
+  resharding the activations at stage boundaries;
+- the embedding and the head/loss run *outside* the manual region, once per
+  microbatch tick, with the vocab dimension of their weights sharded over
+  ``('pp',) + vocab_tp`` — vocab-layer state is 1/(pp * vtp) per device
+  (the reference instead replicates full embed/head per pp group,
+  GPTModel_sequential.py:201-248) and the head matmul is parallelised over
+  the whole mesh, which costs the same wall-clock as the reference's
+  last-stage placement (the last stage is the critical path either way) and
+  strictly less memory.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, List, NamedTuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from galvatron_tpu.config.strategy import HybridParallelConfig
+from galvatron_tpu.parallel import spec as S
+from galvatron_tpu.parallel.mesh import PP_AXIS, layer_axes, vocab_axes
+
+Params = Dict[str, Any]
+
+
+def validate_1f1b_config(hp: HybridParallelConfig):
+    """The stacked-parameter layout needs equal layers per stage with the same
+    param-tree *shapes* per within-stage slot; strategies may differ freely
+    across stages (unlike the gpipe scan's uniformity requirement)."""
+    if hp.pp <= 1:
+        return
+    div = hp.pp_division
+    if len(set(div)) != 1:
+        raise ValueError(
+            "1f1b pipeline requires equal layers per stage, got pp_division=%s" % (div,)
+        )
+    for s in hp.layers:
+        if s.cp > 1:
+            raise ValueError("cp>1 with pp>1 is not yet supported in the 1f1b pipeline")
+    if hp.global_bsz % hp.chunks != 0:
+        raise ValueError("global_bsz must divide into chunks")
+
+
+# ================================================================== schedule
+class Schedule(NamedTuple):
+    """Precomputed (T, pp) 1F1B timetable (all numpy, trace-time constants)."""
+
+    T: int
+    stash: int
+    fwd_mb: np.ndarray  # (T, pp) microbatch whose forward runs
+    fwd_valid: np.ndarray  # (T, pp) bool
+    arr_mb: np.ndarray  # (T, pp) microbatch arriving from the previous stage
+    arr_valid: np.ndarray
+    bwd_mb: np.ndarray  # (T, pp) microbatch whose backward runs
+    bwd_valid: np.ndarray
+    exit_mb: np.ndarray  # (T,) microbatch leaving the last stage this tick
+    exit_valid: np.ndarray
+    inject_mb: np.ndarray  # (T,) microbatch embedded for stage-0 injection
+
+
+def build_schedule(pp: int, chunks: int) -> Schedule:
+    """Classic 1F1B slot equations, generated forward and inverted to tables.
+
+    fwd(i, s) = s + i                     for i < pp - s   (warmup)
+                2 i + s                   otherwise        (steady/cooldown)
+    bwd(j, s) = 2 j + 2 pp - s - 1
+    """
+    f = np.zeros((chunks, pp), np.int64)
+    b = np.zeros((chunks, pp), np.int64)
+    for s in range(pp):
+        for i in range(chunks):
+            f[i, s] = s + i if i < pp - s else 2 * i + s
+            b[i, s] = 2 * i + 2 * pp - s - 1
+    T = int(b[chunks - 1, 0]) + 1
+    stash = min(pp, chunks)
+
+    fwd_mb = np.zeros((T, pp), np.int32)
+    fwd_valid = np.zeros((T, pp), bool)
+    bwd_mb = np.zeros((T, pp), np.int32)
+    bwd_valid = np.zeros((T, pp), bool)
+    for s in range(pp):
+        for i in range(chunks):
+            t = f[i, s]
+            assert not fwd_valid[t, s] and not bwd_valid[t, s], "schedule slot clash"
+            fwd_mb[t, s], fwd_valid[t, s] = i, True
+            t = b[i, s]
+            assert not fwd_valid[t, s] and not bwd_valid[t, s], "schedule slot clash"
+            bwd_mb[t, s], bwd_valid[t, s] = i, True
+
+    # arrival at stage s (tick after the producer's forward); stage 0's
+    # "arrival" is the embedding injection at its own forward tick.
+    arr_mb = np.zeros((T, pp), np.int32)
+    arr_valid = np.zeros((T, pp), bool)
+    arr_mb[:, 0], arr_valid[:, 0] = fwd_mb[:, 0], fwd_valid[:, 0]
+    arr_mb[1:, 1:], arr_valid[1:, 1:] = fwd_mb[:-1, :-1], fwd_valid[:-1, :-1]
+
+    # stash-slot safety: an arriving microbatch's circular slot (mb % stash)
+    # must be free, i.e. microbatch mb - stash was already popped.
+    for s in range(pp):
+        for i in range(stash, chunks):
+            arr = f[i, s - 1] + 1 if s > 0 else f[i, 0]
+            assert b[i - stash, s] < arr, "stash slot clash at stage %d mb %d" % (s, i)
+
+    return Schedule(
+        T=T, stash=stash,
+        fwd_mb=fwd_mb, fwd_valid=fwd_valid,
+        arr_mb=arr_mb, arr_valid=arr_valid,
+        bwd_mb=bwd_mb, bwd_valid=bwd_valid,
+        exit_mb=fwd_mb[:, pp - 1].copy(), exit_valid=fwd_valid[:, pp - 1].copy(),
+        inject_mb=np.clip(fwd_mb[:, 0], 0, chunks - 1),
+    )
+
+
+# ============================================================== vocab sharding
+def vocab_param_specs(cfg, hp: HybridParallelConfig) -> Params:
+    """Override specs for the vocab layers under the 1f1b pipeline: the vocab
+    dim is sharded over ('pp',) + vocab_tp, so embed/head state is split
+    across pipeline groups instead of replicated per group."""
+    from galvatron_tpu.models import base as M
+
+    vax = vocab_axes(hp)
+    specs = M.model_param_specs(cfg, hp)
+    z3 = S._ax(vax.dp) if vax.zero3 else None
+    vocab_ax = S._ax((PP_AXIS,) + (() if vax.ulysses else tuple(vax.tp)))
+    if cfg.input_type != "patches":
+        specs["embed"]["wte"] = P(vocab_ax, z3)
+    if cfg.head_type in ("lm", "mlm") and not cfg.tie_embeddings:
+        specs["lm_head"]["kernel"] = P(None, vocab_ax)
+    if cfg.head_type == "mlm":
+        specs["head"]["bias"] = P(vocab_ax)
+    return specs
+
+
+def _logits_spec_pp(vax) -> P:
+    vocab_ax = S._ax((PP_AXIS,) + (() if vax.ulysses else tuple(vax.tp)))
+    seq_ax = S._ax(vax.seq_axes) if vax.ulysses else S._ax(vax.cp)
+    return P(S._ax(vax.batch_axes), seq_ax, vocab_ax)
+
+
+# ==================================================================== engine
+def make_loss_and_grad(cfg, hp: HybridParallelConfig, mesh: Mesh):
+    """Build ``fn(params, batch) -> (loss, grads)`` running the 1F1B schedule.
+
+    The gradients are the token-weighted sum of per-microbatch gradients —
+    the same objective as the chunked gradient-accumulation path in
+    runtime/model_api.py (verified against it in
+    tests/parallel/test_pipeline_1f1b.py)."""
+    from galvatron_tpu.models import base as M
+
+    validate_1f1b_config(hp)
+    pp, chunks = hp.pp, hp.chunks
+    lps = hp.pp_division[0]
+    vax = vocab_axes(hp)
+    sched = build_schedule(pp, chunks)
+    perm_up = [(i, i + 1) for i in range(pp - 1)]
+    perm_down = [(i, i - 1) for i in range(1, pp)]
+
+    mb_spec = P(S._ax(vax.batch_axes), S._ax(vax.seq_axes), None)  # (mb, S, H)
+    buf_spec = P(PP_AXIS, S._ax(vax.batch_axes), S._ax(vax.seq_axes), None)
+    stash_spec = P(PP_AXIS, None, S._ax(vax.batch_axes), S._ax(vax.seq_axes), None)
+
+    # ------------------------------------------------- per-stage forward body
+    def stage_body(s: int):
+        lo = s * lps
+
+        def body(stage_layers: List[Params], x, pos, bias):
+            for j in range(lps):
+                gi = lo + j
+                ax = layer_axes(hp, gi)
+                x = S.constrain(x, mesh, S.act_spec(ax))
+                fwd = partial(M.layer_forward, cfg=cfg, mesh=mesh, axes=ax,
+                              attn_bias=bias)
+                if hp.layers[gi].checkpoint:
+                    fwd = jax.checkpoint(fwd)
+                x = fwd(stage_layers[j], x, pos)
+            return S.constrain(x, mesh, mb_spec)
+
+        return body
+
+    bodies = [stage_body(s) for s in range(pp)]
+
+    # ------------------------------------------------------- vocab fwd pieces
+    def embed_fwd(vparams, inputs, positions, token_types):
+        """Vocab-parallel embedding with the table's vocab dim sharded over
+        (pp, vtp): the one-hot einsum partitions into masked local lookup +
+        psum across all pipeline groups (cf. base.py embed_tokens; forced to
+        the one-hot path because pp always shards the vocab here)."""
+        emb = vparams["embed"]
+        dtype = cfg.compute_dtype
+        if cfg.input_type == "patches":
+            x = M.embed_patches(emb, inputs, cfg)
+            return S.constrain(x, mesh, mb_spec)
+        onehot = jax.nn.one_hot(inputs, cfg.vocab_size, dtype=dtype)
+        x = jnp.einsum("bsv,vh->bsh", onehot, emb["wte"].astype(dtype))
+        if cfg.position_type == "learned":
+            x = x + emb["wpe"].astype(dtype)[positions]
+        if cfg.type_vocab_size:
+            tti = token_types if token_types is not None else jnp.zeros_like(inputs)
+            x = x + emb["tte"].astype(dtype)[tti]
+        if cfg.embed_norm:
+            x = M._norm(x, emb["norm"], cfg)
+        return S.constrain(x, mesh, mb_spec)
+
+    def head_loss(vparams, y, labels, loss_mask, weight):
+        h = S.constrain(y, mesh, mb_spec)
+        logits = M.model_head(vparams, h, cfg)
+        if cfg.head_type == "classification":
+            return M.softmax_nll(logits, labels) * weight
+        logits = S.constrain(logits, mesh, _logits_spec_pp(vax))
+        return M.vocab_parallel_cross_entropy(logits, labels, loss_mask) * weight
+
+    def loss_and_grad(params, batch):
+        vparams = {k: v for k, v in params.items() if k != "stages"}
+        stages = params["stages"]  # list of lps stacked (pp, ...) trees
+        B = batch[next(iter(batch))].shape[0]
+        mb = B // chunks
+
+        def split(x):
+            return x.reshape((chunks, mb) + x.shape[1:])
+
+        if cfg.input_type == "patches":
+            inputs_mb = split(batch["pixels"])
+            Sq = cfg.max_seq_len
+            pos_mb = jnp.zeros((chunks, mb, Sq), jnp.int32)
+        else:
+            inputs_mb = split(batch["tokens"])
+            pos_mb = split(batch["positions"])
+            Sq = inputs_mb.shape[-1]
+        labels_mb = split(batch["labels"])
+        tti_mb = (
+            split(batch["token_type_ids"])
+            if batch.get("token_type_ids") is not None else None
+        )
+        mask_mb = split(batch["loss_mask"]) if batch.get("loss_mask") is not None else None
+        has_bias = batch.get("attn_mask") is not None
+        bias_mb = (
+            split(M.padding_attn_bias(batch["attn_mask"]))
+            if has_bias else jnp.zeros((chunks, 1), jnp.float32)  # unused dummy
+        )
+
+        # per-microbatch loss weights: keeps the chunked objective identical
+        # to chunks=1 (as in model_api.make_train_step)
+        if mask_mb is not None:
+            msums = jnp.sum(mask_mb.astype(jnp.float32), axis=tuple(range(1, mask_mb.ndim)))
+            weights = msums / jnp.maximum(jnp.sum(msums), 1.0)
+        else:
+            weights = jnp.full((chunks,), 1.0 / chunks, jnp.float32)
+
+        H = cfg.hidden_size
+        act_dtype = cfg.compute_dtype
+
+        def tick_inner(stages_in, sgrads_in, x_out, g_out, stash, x_inj, dy,
+                       pos_f_all, pos_b_all, bias_f_all, bias_b_all,
+                       fwd_mb_t, fwd_v_t, arr_mb_t, arr_v_t, bwd_mb_t, bwd_v_t):
+            stage = lax.axis_index(PP_AXIS)
+            local = [jax.tree.map(lambda a: a[0], t) for t in stages_in]
+            glocal = [jax.tree.map(lambda a: a[0], t) for t in sgrads_in]
+
+            # --- arrival: previous tick's outputs shift up one stage; the
+            # stage-0 arrival is this tick's embedded injection.
+            x_arr = lax.ppermute(x_out[0], PP_AXIS, perm_up)
+            x_arr = jnp.where(stage == 0, x_inj, x_arr)
+            aslot = arr_mb_t[stage] % sched.stash
+            old = lax.dynamic_index_in_dim(stash[0], aslot, 0, keepdims=False)
+            stash_new = lax.dynamic_update_index_in_dim(
+                stash[0], jnp.where(arr_v_t[stage], x_arr, old), aslot, 0
+            )
+
+            # --- forward tick
+            fmb = fwd_mb_t[stage]
+            x_f = lax.dynamic_index_in_dim(stash_new, fmb % sched.stash, 0, keepdims=False)
+            pos_f = pos_f_all[0]
+            bias_f = bias_f_all[0] if has_bias else None
+
+            def run_fwd(x):
+                return lax.switch(stage, bodies, local, x, pos_f, bias_f)
+
+            y = lax.cond(fwd_v_t[stage], run_fwd, jnp.zeros_like, x_f)
+
+            # --- backward tick (hand-written vjp; stage-granular remat)
+            g_arr = lax.ppermute(g_out[0], PP_AXIS, perm_down)
+            g_in = jnp.where(stage == pp - 1, dy, g_arr)
+            bmb = bwd_mb_t[stage]
+            x_b = lax.dynamic_index_in_dim(stash_new, bmb % sched.stash, 0, keepdims=False)
+            pos_b = pos_b_all[0]
+            bias_b = bias_b_all[0] if has_bias else None
+
+            def run_bwd(g):
+                def fb(ps, xx):
+                    return lax.switch(stage, bodies, ps, xx, pos_b, bias_b)
+
+                _, vjp = jax.vjp(fb, local, x_b)
+                return vjp(g)
+
+            def zero_bwd(g):
+                return jax.tree.map(jnp.zeros_like, local), jnp.zeros_like(x_b)
+
+            dps, dx = lax.cond(bwd_v_t[stage], run_bwd, zero_bwd, g_in)
+            glocal = jax.tree.map(jnp.add, glocal, dps)
+
+            return (
+                y[None],
+                dx[None],
+                stash_new[None],
+                [jax.tree.map(lambda a: a[None], t) for t in glocal],
+            )
+
+        pp_specs = [jax.tree.map(lambda _: P(PP_AXIS), t) for t in stages]
+        smap = jax.shard_map(
+            tick_inner,
+            mesh=mesh,
+            in_specs=(
+                pp_specs, pp_specs,                      # stages, sgrads
+                P(PP_AXIS), P(PP_AXIS), P(PP_AXIS),      # x_out, g_out, stash
+                P(), P(),                                # x_inj, dy
+                P(PP_AXIS), P(PP_AXIS), P(PP_AXIS), P(PP_AXIS),  # pos/bias rows
+                P(), P(), P(), P(), P(), P(),            # schedule vectors
+            ),
+            out_specs=(P(PP_AXIS), P(PP_AXIS), P(PP_AXIS), pp_specs),
+            axis_names={PP_AXIS},
+            check_vma=False,
+        )
+
+        def gather_mb(table, idx):
+            return lax.dynamic_index_in_dim(
+                table, jnp.clip(idx, 0, chunks - 1), 0, keepdims=False
+            )
+
+        def tick(carry, xt):
+            x_out, g_out, dy, stash, loss, sgrads, vgrads = carry
+
+            # [world] embed the microbatch injected at stage 0 this tick
+            inj = xt["inject_mb"]
+            tok = gather_mb(inputs_mb, inj)
+            pos_i = gather_mb(pos_mb, inj)
+            tti_i = gather_mb(tti_mb, inj) if tti_mb is not None else None
+            x_inj = embed_fwd(vparams, tok, pos_i, tti_i).astype(act_dtype)
+
+            # per-stage microbatch rows for this tick's fwd/bwd stage work,
+            # gathered in the world region ((pp, ...) pp-sharded operands)
+            def rows(table, idxs):
+                # pp-sharded on dim 0 and REPLICATED elsewhere: any resharding
+                # of these small operands must happen here in the world region,
+                # never inside the divergent per-stage cond branches (a
+                # collective there would rendezvous across stages running
+                # different branches and deadlock).
+                out = jnp.take(table, jnp.clip(idxs, 0, chunks - 1), axis=0)
+                return S.constrain(out, mesh, P(*([PP_AXIS] + [None] * (out.ndim - 1))))
+
+            pos_f_all = rows(pos_mb, xt["fwd_mb"])
+            pos_b_all = rows(pos_mb, xt["bwd_mb"])
+            bias_f_all = rows(bias_mb, xt["fwd_mb"])
+            bias_b_all = rows(bias_mb, xt["bwd_mb"])
+
+            # [manual pp] arrivals + one forward and one backward stage tick
+            x_out, g_out, stash, sgrads = smap(
+                stages, sgrads, x_out, g_out, stash, x_inj, dy,
+                pos_f_all, pos_b_all, bias_f_all, bias_b_all,
+                xt["fwd_mb"], xt["fwd_v"], xt["arr_mb"],
+                xt["arr_v"], xt["bwd_mb"], xt["bwd_v"],
+            )
+
+            # [world] head + loss for the microbatch leaving the last stage;
+            # its cotangent feeds the last stage's backward NEXT tick
+            # (bwd(j, pp-1) = fwd-exit(j) + 1 by the slot equations).
+            e = xt["exit_mb"]
+            ev = xt["exit_v"].astype(jnp.float32)
+            labels_e = gather_mb(labels_mb, e)
+            mask_e = gather_mb(mask_mb, e) if mask_mb is not None else None
+            w_e = weights[jnp.clip(e, 0, chunks - 1)]
+            y_last = x_out[pp - 1]
+            l_e, head_vjp = jax.vjp(
+                lambda vp, yy: head_loss(vp, yy, labels_e, mask_e, w_e), vparams, y_last
+            )
+            dvp_head, dy_new = head_vjp(ev)
+            loss = loss + l_e * ev
+            vgrads = jax.tree.map(jnp.add, vgrads, dvp_head)
+
+            # [world] embedding backward for the microbatch whose stage-0
+            # backward ran this tick (its dx just came out of the manual region)
+            b0 = xt["bwd_mb0"]
+            b0v = xt["bwd_v0"].astype(act_dtype)
+            tok_b = gather_mb(inputs_mb, b0)
+            pos_b = gather_mb(pos_mb, b0)
+            tti_b = gather_mb(tti_mb, b0) if tti_mb is not None else None
+            dx0 = g_out[0]
+            _, embed_vjp = jax.vjp(
+                lambda vp: embed_fwd(vp, tok_b, pos_b, tti_b).astype(act_dtype), vparams
+            )
+            (dvp_embed,) = embed_vjp(dx0 * b0v)
+            vgrads = jax.tree.map(jnp.add, vgrads, dvp_embed)
+
+            return (x_out, g_out, dy_new.astype(act_dtype), stash, loss, sgrads, vgrads), None
+
+        xs = {
+            "fwd_mb": jnp.asarray(sched.fwd_mb),
+            "fwd_v": jnp.asarray(sched.fwd_valid),
+            "arr_mb": jnp.asarray(sched.arr_mb),
+            "arr_v": jnp.asarray(sched.arr_valid),
+            "bwd_mb": jnp.asarray(sched.bwd_mb),
+            "bwd_v": jnp.asarray(sched.bwd_valid),
+            "bwd_mb0": jnp.asarray(sched.bwd_mb[:, 0]),
+            "bwd_v0": jnp.asarray(sched.bwd_valid[:, 0]),
+            "exit_mb": jnp.asarray(sched.exit_mb),
+            "exit_v": jnp.asarray(sched.exit_valid),
+            "inject_mb": jnp.asarray(sched.inject_mb),
+        }
+
+        carry0 = (
+            S.constrain(jnp.zeros((pp, mb, Sq, H), act_dtype), mesh, buf_spec),
+            S.constrain(jnp.zeros((pp, mb, Sq, H), act_dtype), mesh, buf_spec),
+            jnp.zeros((mb, Sq, H), act_dtype),
+            S.constrain(jnp.zeros((pp, sched.stash, mb, Sq, H), act_dtype), mesh, stash_spec),
+            jnp.zeros((), jnp.float32),
+            jax.tree.map(jnp.zeros_like, stages),
+            jax.tree.map(jnp.zeros_like, vparams),
+        )
+        final, _ = lax.scan(tick, carry0, xs)
+        loss, sgrads, vgrads = final[4], final[5], final[6]
+        grads = dict(vgrads)
+        grads["stages"] = sgrads
+        return loss, grads
+
+    return loss_and_grad

@@ -50,6 +50,9 @@ class HybridParallelModel:
     forward_fn: Callable  # (params, batch) -> logits
     init_fn: Optional[Callable] = None  # (rng) -> params; families with their
     # own param tree (t5/swin) supply this instead of base.init_model_params
+    grad_fn: Optional[Callable] = None  # (params, batch) -> (loss, grads);
+    # set by the 1f1b pipeline, whose hand-written schedule produces gradients
+    # directly instead of going through jax.value_and_grad
 
     # ------------------------------------------------------------------ params
     def shardings(self, specs=None):
@@ -145,7 +148,14 @@ class HybridParallelModel:
             def mb_loss(p, mb):
                 return self.loss_fn(p, mb)
 
-            if chunks == 1:
+            if self.grad_fn is not None:
+                # 1f1b pipeline: loss and grads come out of the hand-written
+                # warmup/steady/cooldown schedule in one pass.
+                loss, grads = self.grad_fn(params, batch)
+                grads = jax.tree.map(
+                    lambda g, s: jax.lax.with_sharding_constraint(g, s), grads, accum_shardings
+                )
+            elif chunks == 1:
                 loss, grads = jax.value_and_grad(mb_loss)(params, batch)
                 grads = jax.tree.map(
                     lambda g, s: jax.lax.with_sharding_constraint(g, s), grads, accum_shardings
@@ -206,7 +216,18 @@ def construct_hybrid_parallel_model(
 ) -> HybridParallelModel:
     mesh = build_mesh(hp, devices)
     specs = M.model_param_specs(cfg, hp)
-    if hp.pp > 1:
+    grad_fn = None
+    if hp.pp > 1 and hp.pipeline_type == "pipedream_flush":
+        from galvatron_tpu.parallel import pipeline_1f1b
+        from galvatron_tpu.parallel.pipeline import stack_layer_specs
+
+        specs = pipeline_1f1b.vocab_param_specs(cfg, hp)
+        specs["stages"] = stack_layer_specs(cfg, hp)
+        del specs["layers"]
+        grad_fn = pipeline_1f1b.make_loss_and_grad(cfg, hp, mesh)
+        base_loss = lambda p, b: grad_fn(p, b)[0]
+        fwd = None
+    elif hp.pp > 1:
         from galvatron_tpu.parallel.pipeline import make_pipelined_loss, stack_layer_specs
 
         specs["stages"] = stack_layer_specs(cfg, hp)
@@ -232,4 +253,5 @@ def construct_hybrid_parallel_model(
         param_specs=specs,
         loss_fn=loss_fn or base_loss,
         forward_fn=fwd,
+        grad_fn=grad_fn,
     )
