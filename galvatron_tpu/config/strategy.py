@@ -144,6 +144,27 @@ class HybridParallelConfig:
                 "global_bsz %d must be a multiple of the largest layer dp degree %d"
                 % (self.global_bsz, max_dp)
             )
+        # Under the 1F1B schedule the sharded unit is the MICROBATCH, and it
+        # must shard EVENLY over every LAYER's dp degree: an uneven batch
+        # shard makes GSPMD pad and reshard with collective-permutes, which
+        # the schedule's stage-divergent branches cannot host (see
+        # parallel/pipeline_1f1b.py divergence-safety invariant). The vocab
+        # layers are exempt — embed/head run in the schedule's uniform
+        # (non-branch) region, where padding reshards are safe — as are pp=1
+        # and the gpipe scan (uniform code throughout).
+        if self.pp > 1 and self.pipeline_type == "pipedream_flush":
+            if self.global_bsz % self.chunks != 0:
+                raise ValueError(
+                    "global_bsz %d must divide into %d chunks" % (self.global_bsz, self.chunks)
+                )
+            mb = self.global_bsz // self.chunks
+            max_layer_dp = max(per_stage // (s.tp * s.cp) for s in self.layers)
+            if mb % max_layer_dp != 0:
+                raise ValueError(
+                    "1F1B microbatch size %d (global_bsz %d / chunks %d) must be "
+                    "a multiple of the largest layer dp degree %d"
+                    % (mb, self.global_bsz, self.chunks, max_layer_dp)
+                )
         if self.cp_mode not in ("ring", "zigzag"):
             raise ValueError("cp_mode must be 'ring' or 'zigzag', got %r" % (self.cp_mode,))
 

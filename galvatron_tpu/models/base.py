@@ -262,6 +262,15 @@ def layer_forward(
     y = _norm(x, p["ln1"], cfg) if cfg.pre_norm else x
     q, k, v = qkv_projection(p, y, cfg, dtype)
     if cfg.position_type == "rope":
+        if mesh is not None and axes is not None:
+            # Pin positions to THIS layer's sharding so each layer derives its
+            # own rope cos/sin tables in its own layout. Without this, XLA CSEs
+            # the identical table computation across adjacent layers with
+            # different strategies and reshards the shared result — under the
+            # 1F1B schedule's divergent branches that reshard can be a
+            # collective-permute, which deadlocks across stages (see
+            # parallel/pipeline_1f1b.py divergence-safety invariant).
+            positions = S.constrain(positions, mesh, S.act_spec(axes, ndim=2))
         q = apply_rotary(q, positions, cfg.rope_theta)
         k = apply_rotary(k, positions, cfg.rope_theta)
     if mesh is not None and axes is not None and len(axes.tp) + len(axes.cp) > 0:

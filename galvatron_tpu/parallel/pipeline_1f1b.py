@@ -4,22 +4,28 @@ TPU-native re-design of the reference's 1F1B engine
 (galvatron/core/runtime/pipeline/pipeline.py:375-701 — warmup :455-495,
 steady one-forward-one-backward :512-631, cooldown :640-691, batched P2P
 :1080-1257). The reference runs per-rank Python schedules with NCCL
-send/recv; here the whole schedule — forward ticks, backward ticks, the
-bounded activation stash, and the hand-written backward — is ONE jitted
-`lax.scan` whose body enters a `shard_map` that is *manual* over the ``pp``
-mesh axis and *auto* (GSPMD) over the within-stage axes:
+send/recv; here the whole schedule — embedding, forward ticks, backward
+ticks, the bounded activation stash, the hand-written backward, and the
+head/loss — is ONE `lax.scan` inside ONE `shard_map` that is *manual* over
+the ``pp`` mesh axis and *auto* (GSPMD) over the within-stage axes:
 
 - each device knows its stage via ``lax.axis_index('pp')`` and follows its
-  own row of a precomputed (T, pp) schedule table: classic 1F1B timing
-  ``fwd(i, s) = s + i`` during warmup (depth ``pp - s``), ``2 i + s`` in
-  steady state, ``bwd(j, s) = 2 j + 2 pp - s - 1`` — so the steady state
-  alternates one forward and one backward per stage and stage s holds at
-  most ``pp - s`` in-flight microbatches (the 1F1B activation watermark,
-  reference cost_model.py:85-97), independent of ``chunks``;
-- stage boundaries are explicit ``lax.ppermute`` sends (the analogue of the
-  reference's ``batch_isend_irecv``) — activations up, cotangents down;
+  own row of a precomputed (T, pp) schedule table: 1F1B timing
+  ``fwd(i, s) = s + i`` during warmup, ``2 i + s`` in steady state,
+  ``bwd(j, s) = 2 j + 2 pp - s`` — the steady state alternates one forward
+  and one backward per stage and stage s holds at most ``pp - s + 1``
+  in-flight microbatches (the 1F1B activation watermark, reference
+  cost_model.py:85-97), independent of ``chunks``;
+- ALL cross-stage movement rides exactly ONE ``lax.all_gather`` over ``pp``
+  per tick, carrying the previous tick's stage outputs (the analogue of the
+  reference's ``batch_isend_irecv`` round): each stage slices its arriving
+  activation, its arriving cotangent, the exiting activation for the
+  head/loss, and stage 0's input cotangent for the embedding backward. One
+  collective per tick + the scan's iteration barrier makes the cross-stage
+  collective order total BY CONSTRUCTION — see the divergence-safety notes
+  in `make_loss_and_grad` for why weaker designs deadlock;
 - the backward is hand-written inside the scan: each backward tick pops the
-  saved stage *input* from a ``min(pp, chunks)``-deep circular stash and
+  saved stage *input* from a ``min(pp + 1, chunks)``-deep circular stash and
   calls ``jax.vjp`` on the stage body (stage-granular rematerialisation —
   the same compute budget as the reference's 1F1B with
   ``--checkpoint_activations``), accumulating parameter gradients in a
@@ -27,16 +33,16 @@ mesh axis and *auto* (GSPMD) over the within-stage axes:
   residuals are saved — the live set is the stash plus one transient stage;
 - per-stage bodies are selected with ``lax.switch``, so every stage may run
   its own layer strategies (tp/sp/fsdp/ckpt per layer — the reference's
-  layer-wise heterogeneity, hybrid_parallel_model.py:263-268) with GSPMD
-  resharding the activations at stage boundaries;
-- the embedding and the head/loss run *outside* the manual region, once per
-  microbatch tick, with the vocab dimension of their weights sharded over
-  ``('pp',) + vocab_tp`` — vocab-layer state is 1/(pp * vtp) per device
-  (the reference instead replicates full embed/head per pp group,
-  GPTModel_sequential.py:201-248) and the head matmul is parallelised over
-  the whole mesh, which costs the same wall-clock as the reference's
-  last-stage placement (the last stage is the critical path either way) and
-  strictly less memory.
+  layer-wise heterogeneity, hybrid_parallel_model.py:263-268), with only
+  group-scoped within-stage collectives allowed inside the divergent
+  branches;
+- the embedding and the head/loss run once per tick on every stage
+  (redundantly — the last stage is the critical path either way), computing
+  in the within-stage vocab_tp layout; their parameters are STORED with the
+  vocab dimension sharded over ``('pp',) + vocab_tp`` (1/(pp*vtp) state per
+  device, vs the reference's full replication per pp group,
+  GPTModel_sequential.py:201-248) and gathered to the within-stage layout
+  once per step at the shard_map boundary.
 """
 
 from __future__ import annotations
@@ -88,26 +94,43 @@ class Schedule(NamedTuple):
     arr_valid: np.ndarray
     bwd_mb: np.ndarray  # (T, pp) microbatch whose backward runs
     bwd_valid: np.ndarray
-    exit_mb: np.ndarray  # (T,) microbatch leaving the last stage this tick
-    exit_valid: np.ndarray
+    head_mb: np.ndarray  # (T,) microbatch whose head/loss runs this tick
+    head_valid: np.ndarray
+    emb_mb: np.ndarray  # (T,) microbatch whose embedding backward runs
+    emb_valid: np.ndarray
     inject_mb: np.ndarray  # (T,) microbatch embedded for stage-0 injection
 
 
 def build_schedule(pp: int, chunks: int) -> Schedule:
-    """Classic 1F1B slot equations, generated forward and inverted to tables.
+    """1F1B slot equations, generated forward and inverted to tables.
 
     fwd(i, s) = s + i                     for i < pp - s   (warmup)
                 2 i + s                   otherwise        (steady/cooldown)
-    bwd(j, s) = 2 j + 2 pp - s - 1
+    bwd(j, s) = 2 j + 2 pp - s
+
+    All cross-stage movement rides ONE all-gather per tick carrying the
+    PREVIOUS tick's stage outputs (see schedule_body), so every stage
+    boundary costs one tick: forwards chain as fwd(i, s) = fwd(i, s-1) + 1;
+    the head/loss runs one tick after the last-stage forward
+    (head(i) = fwd(i, pp-1) + 1); the last stage's backward consumes the
+    cotangent one tick after that (bwd(i, pp-1) = head(i) + 1); cotangents
+    then flow down one stage per tick (bwd(i, s) = bwd(i, s+1) + 1); and the
+    embedding backward runs one tick after stage 0's backward. Compared to
+    the textbook per-rank 1F1B this costs 2 extra pipeline ticks end-to-end
+    and one extra stash slot (min(pp+1, chunks)) — the price of keeping a
+    single, trivially-ordered cross-stage collective per tick. A tick may
+    host BOTH a forward and a backward on the same stage (the two slot
+    equations share parity); the engine runs them as separate branches.
     """
     f = np.zeros((chunks, pp), np.int64)
     b = np.zeros((chunks, pp), np.int64)
     for s in range(pp):
         for i in range(chunks):
             f[i, s] = s + i if i < pp - s else 2 * i + s
-            b[i, s] = 2 * i + 2 * pp - s - 1
-    T = int(b[chunks - 1, 0]) + 1
-    stash = min(pp, chunks)
+            b[i, s] = 2 * i + 2 * pp - s
+    # +1 past the last stage-0 backward so its embedding backward still runs
+    T = int(b[chunks - 1, 0]) + 2
+    stash = min(pp + 1, chunks)
 
     fwd_mb = np.zeros((T, pp), np.int32)
     fwd_valid = np.zeros((T, pp), bool)
@@ -116,10 +139,10 @@ def build_schedule(pp: int, chunks: int) -> Schedule:
     for s in range(pp):
         for i in range(chunks):
             t = f[i, s]
-            assert not fwd_valid[t, s] and not bwd_valid[t, s], "schedule slot clash"
+            assert not fwd_valid[t, s], "duplicate forward slot"
             fwd_mb[t, s], fwd_valid[t, s] = i, True
             t = b[i, s]
-            assert not fwd_valid[t, s] and not bwd_valid[t, s], "schedule slot clash"
+            assert not bwd_valid[t, s], "duplicate backward slot"
             bwd_mb[t, s], bwd_valid[t, s] = i, True
 
     # arrival at stage s (tick after the producer's forward); stage 0's
@@ -130,18 +153,31 @@ def build_schedule(pp: int, chunks: int) -> Schedule:
     arr_mb[1:, 1:], arr_valid[1:, 1:] = fwd_mb[:-1, :-1], fwd_valid[:-1, :-1]
 
     # stash-slot safety: an arriving microbatch's circular slot (mb % stash)
-    # must be free, i.e. microbatch mb - stash was already popped.
+    # must be free, i.e. microbatch mb - stash was already popped (strictly
+    # earlier: within a tick the arrival write precedes the backward read).
     for s in range(pp):
         for i in range(stash, chunks):
-            arr = f[i, s - 1] + 1 if s > 0 else f[i, 0]
-            assert b[i - stash, s] < arr, "stash slot clash at stage %d mb %d" % (s, i)
+            assert b[i - stash, s] < f[i, s], (
+                "stash slot clash at stage %d mb %d" % (s, i)
+            )
+
+    # head/loss processes the microbatch whose last-stage forward ran the
+    # PREVIOUS tick (its activation arrives via this tick's all-gather)
+    head_mb = np.zeros((T,), np.int32)
+    head_valid = np.zeros((T,), bool)
+    head_mb[1:], head_valid[1:] = fwd_mb[:-1, pp - 1], fwd_valid[:-1, pp - 1]
+    # embedding backward: one tick after stage 0's backward
+    emb_mb = np.zeros((T,), np.int32)
+    emb_valid = np.zeros((T,), bool)
+    emb_mb[1:], emb_valid[1:] = bwd_mb[:-1, 0], bwd_valid[:-1, 0]
 
     return Schedule(
         T=T, stash=stash,
         fwd_mb=fwd_mb, fwd_valid=fwd_valid,
         arr_mb=arr_mb, arr_valid=arr_valid,
         bwd_mb=bwd_mb, bwd_valid=bwd_valid,
-        exit_mb=fwd_mb[:, pp - 1].copy(), exit_valid=fwd_valid[:, pp - 1].copy(),
+        head_mb=head_mb, head_valid=head_valid,
+        emb_mb=emb_mb, emb_valid=emb_valid,
         inject_mb=np.clip(fwd_mb[:, 0], 0, chunks - 1),
     )
 
@@ -166,12 +202,6 @@ def vocab_param_specs(cfg, hp: HybridParallelConfig) -> Params:
     return specs
 
 
-def _logits_spec_pp(vax) -> P:
-    vocab_ax = S._ax((PP_AXIS,) + (() if vax.ulysses else tuple(vax.tp)))
-    seq_ax = S._ax(vax.seq_axes) if vax.ulysses else S._ax(vax.cp)
-    return P(S._ax(vax.batch_axes), seq_ax, vocab_ax)
-
-
 # ==================================================================== engine
 def make_loss_and_grad(cfg, hp: HybridParallelConfig, mesh: Mesh):
     """Build ``fn(params, batch) -> (loss, grads)`` running the 1F1B schedule.
@@ -187,39 +217,78 @@ def make_loss_and_grad(cfg, hp: HybridParallelConfig, mesh: Mesh):
     lps = hp.pp_division[0]
     vax = vocab_axes(hp)
     sched = build_schedule(pp, chunks)
-    perm_up = [(i, i + 1) for i in range(pp - 1)]
-    perm_down = [(i, i - 1) for i in range(1, pp)]
 
     mb_spec = P(S._ax(vax.batch_axes), S._ax(vax.seq_axes), None)  # (mb, S, H)
-    buf_spec = P(PP_AXIS, S._ax(vax.batch_axes), S._ax(vax.seq_axes), None)
-    stash_spec = P(PP_AXIS, None, S._ax(vax.batch_axes), S._ax(vax.seq_axes), None)
 
     # ------------------------------------------------- per-stage forward body
+    # Divergence-safety invariant (the round-2 multichip deadlock, reproduced
+    # and bisected here): these bodies run inside `lax.cond`/`lax.switch`
+    # branches that only SOME stages execute, and XLA:CPU's (and conservatively
+    # TPU's) collective-permute rendezvous spans ALL devices — so any
+    # GSPMD-inserted collective-permute in a branch deadlocks the step. Only
+    # group-scoped collectives (all-reduce / all-gather / reduce-scatter /
+    # grouped all-to-all over within-stage axes) may appear in branch code.
+    # Enforced by (a) axis-monotone reshards between per-layer specs
+    # (S.monotone_constrain), (b) pinning every branch output to a fixed spec
+    # before the branch returns, and (c) the compile-time HLO guard
+    # `assert_no_divergent_global_collectives`.
     def stage_body(s: int):
         lo = s * lps
 
         def body(stage_layers: List[Params], x, pos, bias):
+            prev = mb_spec
             for j in range(lps):
                 gi = lo + j
                 ax = layer_axes(hp, gi)
-                x = S.constrain(x, mesh, S.act_spec(ax))
+                cur = S.act_spec(ax)
+                x = S.monotone_constrain(x, mesh, prev, cur)
                 fwd = partial(M.layer_forward, cfg=cfg, mesh=mesh, axes=ax,
                               attn_bias=bias)
                 if hp.layers[gi].checkpoint:
                     fwd = jax.checkpoint(fwd)
                 x = fwd(stage_layers[j], x, pos)
-            return S.constrain(x, mesh, mb_spec)
+                prev = cur
+            return S.monotone_constrain(x, mesh, prev, mb_spec)
 
         return body
 
     bodies = [stage_body(s) for s in range(pp)]
+    # When every stage runs the same strategy list (the common case, incl.
+    # every stage-uniform searched config), all bodies are identical — skip
+    # the lax.switch so the program has NO stage-divergent control flow at
+    # all (within-layer heterogeneity lives inside the single body).
+    stage_sigs = {
+        tuple(hp.layers[s * lps + j] for j in range(lps)) for s in range(pp)
+    }
+    uniform_stages = len(stage_sigs) == 1
+
+    # XLA:CPU's in-process collective runtime keys rendezvous clique-wide: a
+    # grouped collective executed by only the stage whose fwd/bwd slot is
+    # valid this tick starves devices of other stages that never visit it,
+    # and the schedule deadlocks (bisected live: stage 1 parked in its
+    # backward's ZeRO-3 all-gather while stage 0 idles that tick). On CPU we
+    # therefore run EVERY stage's forward and backward EVERY tick and mask
+    # instead of branching: the cotangent is zeroed for invalid slots (vjp is
+    # linear, so the gradients are exactly zero) and the forward result is
+    # zeroed after the fact. The garbage compute fills ticks that were idle
+    # anyway (fwd and bwd slots share parity per stage), so wall-clock is
+    # unchanged; arithmetic doubles, which only matters for energy. On TPU
+    # collectives are matched statically per replica group, so the efficient
+    # lax.cond path (skip invalid slots) is safe and used.
+    mask_not_branch = jax.default_backend() == "cpu"
 
     # ------------------------------------------------------- vocab fwd pieces
     def embed_fwd(vparams, inputs, positions, token_types):
-        """Vocab-parallel embedding with the table's vocab dim sharded over
-        (pp, vtp): the one-hot einsum partitions into masked local lookup +
-        psum across all pipeline groups (cf. base.py embed_tokens; forced to
-        the one-hot path because pp always shards the vocab here)."""
+        """Vocab-parallel embedding on the within-stage gathered tables (see
+        the vparams gather in loss_and_grad): the one-hot einsum partitions
+        into masked local lookup + psum over the within-stage vocab_tp group
+        (cf. base.py embed_tokens).
+
+        ALL table lookups here are one-hot matmuls, not gathers: the vjp of a
+        gather is a scatter-add, which GSPMD partitions with index-operand
+        collective-permutes outside any dataflow ordering — the deadlock found
+        by driving GPT (learned positions) through the 1F1B schedule. A
+        matmul's vjp is a matmul: dense, orderable, and MXU-friendly."""
         emb = vparams["embed"]
         dtype = cfg.compute_dtype
         if cfg.input_type == "patches":
@@ -228,10 +297,12 @@ def make_loss_and_grad(cfg, hp: HybridParallelConfig, mesh: Mesh):
         onehot = jax.nn.one_hot(inputs, cfg.vocab_size, dtype=dtype)
         x = jnp.einsum("bsv,vh->bsh", onehot, emb["wte"].astype(dtype))
         if cfg.position_type == "learned":
-            x = x + emb["wpe"].astype(dtype)[positions]
+            pos1h = jax.nn.one_hot(positions, cfg.max_seq_len, dtype=dtype)
+            x = x + jnp.einsum("bsp,ph->bsh", pos1h, emb["wpe"].astype(dtype))
         if cfg.type_vocab_size:
             tti = token_types if token_types is not None else jnp.zeros_like(inputs)
-            x = x + emb["tte"].astype(dtype)[tti]
+            tti1h = jax.nn.one_hot(tti, cfg.type_vocab_size, dtype=dtype)
+            x = x + jnp.einsum("bst,th->bsh", tti1h, emb["tte"].astype(dtype))
         if cfg.embed_norm:
             x = M._norm(x, emb["norm"], cfg)
         return S.constrain(x, mesh, mb_spec)
@@ -241,12 +312,15 @@ def make_loss_and_grad(cfg, hp: HybridParallelConfig, mesh: Mesh):
         logits = M.model_head(vparams, h, cfg)
         if cfg.head_type == "classification":
             return M.softmax_nll(logits, labels) * weight
-        logits = S.constrain(logits, mesh, _logits_spec_pp(vax))
+        # within-stage vocab sharding (see the vparams gather in
+        # loss_and_grad): the CE psums stay group-scoped inside the scan
+        logits = S.constrain(logits, mesh, S.logits_spec(vax))
         return M.vocab_parallel_cross_entropy(logits, labels, loss_mask) * weight
 
     def loss_and_grad(params, batch):
-        vparams = {k: v for k, v in params.items() if k != "stages"}
+        vparams_stored = {k: v for k, v in params.items() if k != "stages"}
         stages = params["stages"]  # list of lps stacked (pp, ...) trees
+
         B = batch[next(iter(batch))].shape[0]
         mb = B // chunks
 
@@ -262,20 +336,32 @@ def make_loss_and_grad(cfg, hp: HybridParallelConfig, mesh: Mesh):
             pos_mb = split(batch["positions"])
             Sq = inputs_mb.shape[-1]
         labels_mb = split(batch["labels"])
-        tti_mb = (
-            split(batch["token_type_ids"])
-            if batch.get("token_type_ids") is not None else None
-        )
-        mask_mb = split(batch["loss_mask"]) if batch.get("loss_mask") is not None else None
+        has_tti = batch.get("token_type_ids") is not None
+        tti_mb = split(batch["token_type_ids"]) if has_tti else jnp.zeros((chunks, 1), jnp.int32)
+        has_mask = batch.get("loss_mask") is not None
+        mask_mb = split(batch["loss_mask"]) if has_mask else jnp.zeros((chunks, 1), jnp.float32)
         has_bias = batch.get("attn_mask") is not None
         bias_mb = (
             split(M.padding_attn_bias(batch["attn_mask"]))
             if has_bias else jnp.zeros((chunks, 1), jnp.float32)  # unused dummy
         )
 
+        # Pin every per-tick table fully replicated BEFORE the shard_map: the
+        # in_spec below only governs the manual pp axis, and a table left
+        # auto-sharded over the within-stage axes makes every in-loop
+        # gather/take a partitioned gather (one such gather crashes the GSPMD
+        # partitioner, spmd_partitioner_util.cc:495, and the rest would emit
+        # per-tick collectives for index reads that must stay local).
+        def rep(t):
+            return S.constrain(t, mesh, S.replicated_spec(t.ndim))
+
+        inputs_mb, pos_mb, labels_mb, tti_mb, mask_mb, bias_mb = (
+            rep(t) for t in (inputs_mb, pos_mb, labels_mb, tti_mb, mask_mb, bias_mb)
+        )
+
         # per-microbatch loss weights: keeps the chunked objective identical
         # to chunks=1 (as in model_api.make_train_step)
-        if mask_mb is not None:
+        if has_mask:
             msums = jnp.sum(mask_mb.astype(jnp.float32), axis=tuple(range(1, mask_mb.ndim)))
             weights = msums / jnp.maximum(jnp.sum(msums), 1.0)
         else:
@@ -284,149 +370,6 @@ def make_loss_and_grad(cfg, hp: HybridParallelConfig, mesh: Mesh):
         H = cfg.hidden_size
         act_dtype = cfg.compute_dtype
 
-        def tick_inner(stages_in, sgrads_in, x_out, g_out, stash, x_inj, dy,
-                       pos_f_all, pos_b_all, bias_f_all, bias_b_all,
-                       fwd_mb_t, fwd_v_t, arr_mb_t, arr_v_t, bwd_mb_t, bwd_v_t):
-            stage = lax.axis_index(PP_AXIS)
-            local = [jax.tree.map(lambda a: a[0], t) for t in stages_in]
-            glocal = [jax.tree.map(lambda a: a[0], t) for t in sgrads_in]
-
-            # --- arrival: previous tick's outputs shift up one stage; the
-            # stage-0 arrival is this tick's embedded injection.
-            x_arr = lax.ppermute(x_out[0], PP_AXIS, perm_up)
-            x_arr = jnp.where(stage == 0, x_inj, x_arr)
-            aslot = arr_mb_t[stage] % sched.stash
-            old = lax.dynamic_index_in_dim(stash[0], aslot, 0, keepdims=False)
-            stash_new = lax.dynamic_update_index_in_dim(
-                stash[0], jnp.where(arr_v_t[stage], x_arr, old), aslot, 0
-            )
-
-            # --- forward tick
-            fmb = fwd_mb_t[stage]
-            x_f = lax.dynamic_index_in_dim(stash_new, fmb % sched.stash, 0, keepdims=False)
-            pos_f = pos_f_all[0]
-            bias_f = bias_f_all[0] if has_bias else None
-
-            def run_fwd(x):
-                return lax.switch(stage, bodies, local, x, pos_f, bias_f)
-
-            y = lax.cond(fwd_v_t[stage], run_fwd, jnp.zeros_like, x_f)
-
-            # --- backward tick (hand-written vjp; stage-granular remat)
-            g_arr = lax.ppermute(g_out[0], PP_AXIS, perm_down)
-            g_in = jnp.where(stage == pp - 1, dy, g_arr)
-            bmb = bwd_mb_t[stage]
-            x_b = lax.dynamic_index_in_dim(stash_new, bmb % sched.stash, 0, keepdims=False)
-            pos_b = pos_b_all[0]
-            bias_b = bias_b_all[0] if has_bias else None
-
-            def run_bwd(g):
-                def fb(ps, xx):
-                    return lax.switch(stage, bodies, ps, xx, pos_b, bias_b)
-
-                _, vjp = jax.vjp(fb, local, x_b)
-                return vjp(g)
-
-            def zero_bwd(g):
-                return jax.tree.map(jnp.zeros_like, local), jnp.zeros_like(x_b)
-
-            dps, dx = lax.cond(bwd_v_t[stage], run_bwd, zero_bwd, g_in)
-            glocal = jax.tree.map(jnp.add, glocal, dps)
-
-            return (
-                y[None],
-                dx[None],
-                stash_new[None],
-                [jax.tree.map(lambda a: a[None], t) for t in glocal],
-            )
-
-        pp_specs = [jax.tree.map(lambda _: P(PP_AXIS), t) for t in stages]
-        smap = jax.shard_map(
-            tick_inner,
-            mesh=mesh,
-            in_specs=(
-                pp_specs, pp_specs,                      # stages, sgrads
-                P(PP_AXIS), P(PP_AXIS), P(PP_AXIS),      # x_out, g_out, stash
-                P(), P(),                                # x_inj, dy
-                P(PP_AXIS), P(PP_AXIS), P(PP_AXIS), P(PP_AXIS),  # pos/bias rows
-                P(), P(), P(), P(), P(), P(),            # schedule vectors
-            ),
-            out_specs=(P(PP_AXIS), P(PP_AXIS), P(PP_AXIS), pp_specs),
-            axis_names={PP_AXIS},
-            check_vma=False,
-        )
-
-        def gather_mb(table, idx):
-            return lax.dynamic_index_in_dim(
-                table, jnp.clip(idx, 0, chunks - 1), 0, keepdims=False
-            )
-
-        def tick(carry, xt):
-            x_out, g_out, dy, stash, loss, sgrads, vgrads = carry
-
-            # [world] embed the microbatch injected at stage 0 this tick
-            inj = xt["inject_mb"]
-            tok = gather_mb(inputs_mb, inj)
-            pos_i = gather_mb(pos_mb, inj)
-            tti_i = gather_mb(tti_mb, inj) if tti_mb is not None else None
-            x_inj = embed_fwd(vparams, tok, pos_i, tti_i).astype(act_dtype)
-
-            # per-stage microbatch rows for this tick's fwd/bwd stage work,
-            # gathered in the world region ((pp, ...) pp-sharded operands)
-            def rows(table, idxs):
-                # pp-sharded on dim 0 and REPLICATED elsewhere: any resharding
-                # of these small operands must happen here in the world region,
-                # never inside the divergent per-stage cond branches (a
-                # collective there would rendezvous across stages running
-                # different branches and deadlock).
-                out = jnp.take(table, jnp.clip(idxs, 0, chunks - 1), axis=0)
-                return S.constrain(out, mesh, P(*([PP_AXIS] + [None] * (out.ndim - 1))))
-
-            pos_f_all = rows(pos_mb, xt["fwd_mb"])
-            pos_b_all = rows(pos_mb, xt["bwd_mb"])
-            bias_f_all = rows(bias_mb, xt["fwd_mb"])
-            bias_b_all = rows(bias_mb, xt["bwd_mb"])
-
-            # [manual pp] arrivals + one forward and one backward stage tick
-            x_out, g_out, stash, sgrads = smap(
-                stages, sgrads, x_out, g_out, stash, x_inj, dy,
-                pos_f_all, pos_b_all, bias_f_all, bias_b_all,
-                xt["fwd_mb"], xt["fwd_v"], xt["arr_mb"],
-                xt["arr_v"], xt["bwd_mb"], xt["bwd_v"],
-            )
-
-            # [world] head + loss for the microbatch leaving the last stage;
-            # its cotangent feeds the last stage's backward NEXT tick
-            # (bwd(j, pp-1) = fwd-exit(j) + 1 by the slot equations).
-            e = xt["exit_mb"]
-            ev = xt["exit_v"].astype(jnp.float32)
-            labels_e = gather_mb(labels_mb, e)
-            mask_e = gather_mb(mask_mb, e) if mask_mb is not None else None
-            w_e = weights[jnp.clip(e, 0, chunks - 1)]
-            y_last = x_out[pp - 1]
-            l_e, head_vjp = jax.vjp(
-                lambda vp, yy: head_loss(vp, yy, labels_e, mask_e, w_e), vparams, y_last
-            )
-            dvp_head, dy_new = head_vjp(ev)
-            loss = loss + l_e * ev
-            vgrads = jax.tree.map(jnp.add, vgrads, dvp_head)
-
-            # [world] embedding backward for the microbatch whose stage-0
-            # backward ran this tick (its dx just came out of the manual region)
-            b0 = xt["bwd_mb0"]
-            b0v = xt["bwd_v0"].astype(act_dtype)
-            tok_b = gather_mb(inputs_mb, b0)
-            pos_b = gather_mb(pos_mb, b0)
-            tti_b = gather_mb(tti_mb, b0) if tti_mb is not None else None
-            dx0 = g_out[0]
-            _, embed_vjp = jax.vjp(
-                lambda vp: embed_fwd(vp, tok_b, pos_b, tti_b).astype(act_dtype), vparams
-            )
-            (dvp_embed,) = embed_vjp(dx0 * b0v)
-            vgrads = jax.tree.map(jnp.add, vgrads, dvp_embed)
-
-            return (x_out, g_out, dy_new.astype(act_dtype), stash, loss, sgrads, vgrads), None
-
         xs = {
             "fwd_mb": jnp.asarray(sched.fwd_mb),
             "fwd_v": jnp.asarray(sched.fwd_valid),
@@ -434,26 +377,291 @@ def make_loss_and_grad(cfg, hp: HybridParallelConfig, mesh: Mesh):
             "arr_v": jnp.asarray(sched.arr_valid),
             "bwd_mb": jnp.asarray(sched.bwd_mb),
             "bwd_v": jnp.asarray(sched.bwd_valid),
-            "bwd_mb0": jnp.asarray(sched.bwd_mb[:, 0]),
-            "bwd_v0": jnp.asarray(sched.bwd_valid[:, 0]),
-            "exit_mb": jnp.asarray(sched.exit_mb),
-            "exit_v": jnp.asarray(sched.exit_valid),
+            "head_mb": jnp.asarray(sched.head_mb),
+            "head_v": jnp.asarray(sched.head_valid),
+            "emb_mb": jnp.asarray(sched.emb_mb),
+            "emb_v": jnp.asarray(sched.emb_valid),
             "inject_mb": jnp.asarray(sched.inject_mb),
         }
 
-        carry0 = (
-            S.constrain(jnp.zeros((pp, mb, Sq, H), act_dtype), mesh, buf_spec),
-            S.constrain(jnp.zeros((pp, mb, Sq, H), act_dtype), mesh, buf_spec),
-            jnp.zeros((mb, Sq, H), act_dtype),
-            S.constrain(jnp.zeros((pp, sched.stash, mb, Sq, H), act_dtype), mesh, stash_spec),
-            jnp.zeros((), jnp.float32),
-            jax.tree.map(jnp.zeros_like, stages),
-            jax.tree.map(jnp.zeros_like, vparams),
+        # ------------------------------------------------------------------
+        # The ENTIRE schedule runs inside ONE shard_map that is manual over
+        # ``pp`` — embed, stage ticks, head/loss, and the embedding backward.
+        # Rationale (the round-2/3 multichip deadlocks): XLA:CPU keys each
+        # collective's rendezvous by (run_id, op_id) with no iteration or
+        # branch context, reuses channel ids across distinct ops, and lets a
+        # device park threads in several collectives at once — so once the
+        # per-stage divergent branches skew each stage's executor timeline,
+        # ANY two cross-stage collectives that are not strictly ordered by
+        # dataflow can be entered in opposite orders by different stages and
+        # cross-deadlock (or pair mismatched rendezvous). When the loop body
+        # is GSPMD auto over the whole mesh the partitioner freely creates
+        # such collectives (it re-grids even replicated einsums over the pp
+        # axis). Two structural rules eliminate the class:
+        #   1. manual over pp: GSPMD never sees the pp axis, so it cannot
+        #      invent cross-stage collectives;
+        #   2. exactly ONE hand-placed cross-stage collective per tick — a
+        #      single all-gather of the previous tick's stage outputs, from
+        #      which every stage slices what it needs (activation from below,
+        #      cotangent from above, the exiting activation, stage 0's input
+        #      cotangent). lax.scan's iteration barrier serialises successive
+        #      instances, so the cross-stage order is total by construction.
+        # Within-stage collectives stay GSPMD-auto: a stage's devices share
+        # identical branch history, so their executor order is consistent and
+        # group-scoped rendezvous cannot cross-deadlock.
+        # ------------------------------------------------------------------
+        def schedule_body(stages_in, vparams, inputs_mb, pos_mb, labels_mb,
+                          tti_mb, mask_mb, bias_mb, weights, xs):
+            stage = lax.axis_index(PP_AXIS)
+            local = [jax.tree.map(lambda a: a[0], t) for t in stages_in]
+
+            def gather_mb(table, idx):
+                return lax.dynamic_index_in_dim(
+                    table, jnp.clip(idx, 0, chunks - 1), 0, keepdims=False
+                )
+
+            def stage_row(table, idxs):
+                return gather_mb(table, idxs[stage])
+
+            def tick(carry, xt):
+                y_prev, dx_prev, dy, stash, loss, sgrads, vgrads = carry
+
+                # [uniform] embed this tick's injected microbatch — computed
+                # redundantly by every stage (within-stage collectives only)
+                inj = xt["inject_mb"]
+                tok = gather_mb(inputs_mb, inj)
+                pos_i = gather_mb(pos_mb, inj)
+                tti_i = gather_mb(tti_mb, inj) if has_tti else None
+                x_inj = embed_fwd(vparams, tok, pos_i, tti_i).astype(act_dtype)
+
+                # THE cross-stage collective: every stage's previous-tick
+                # outputs, everywhere. Slices below serve as activation
+                # arrival (stage s-1's forward output), cotangent arrival
+                # (stage s+1's backward output), the exiting activation for
+                # head/loss (stage pp-1), and the embedding backward's input
+                # cotangent (stage 0).
+                prev_all = lax.all_gather(jnp.stack([y_prev, dx_prev]), PP_AXIS)
+                x_arr = lax.dynamic_index_in_dim(
+                    prev_all, jnp.clip(stage - 1, 0, pp - 1), 0, keepdims=False
+                )[0]
+                x_arr = jnp.where(stage == 0, x_inj, x_arr)
+                g_arr = lax.dynamic_index_in_dim(
+                    prev_all, jnp.clip(stage + 1, 0, pp - 1), 0, keepdims=False
+                )[1]
+                y_exit = prev_all[pp - 1, 0]
+                dx0 = prev_all[0, 1]
+
+                aslot = xt["arr_mb"][stage] % sched.stash
+                old = lax.dynamic_index_in_dim(stash, aslot, 0, keepdims=False)
+                stash = lax.dynamic_update_index_in_dim(
+                    stash, jnp.where(xt["arr_v"][stage], x_arr, old), aslot, 0
+                )
+
+                # --- forward tick (divergent branch: within-stage collectives
+                # only — see the divergence-safety invariant above stage_body)
+                fmb = xt["fwd_mb"][stage]
+                x_f = lax.dynamic_index_in_dim(stash, fmb % sched.stash, 0, keepdims=False)
+                pos_f = stage_row(pos_mb, xt["fwd_mb"])
+                bias_f = stage_row(bias_mb, xt["fwd_mb"]) if has_bias else None
+
+                def run_fwd(x):
+                    if uniform_stages:
+                        return bodies[0](local, x, pos_f, bias_f)
+                    return lax.switch(stage, bodies, local, x, pos_f, bias_f)
+
+                if mask_not_branch:
+                    y = run_fwd(x_f) * xt["fwd_v"][stage].astype(act_dtype)
+                else:
+                    y = lax.cond(xt["fwd_v"][stage], run_fwd, jnp.zeros_like, x_f)
+
+                g_in = jnp.where(stage == pp - 1, dy, g_arr)
+
+                # --- backward tick (hand-written vjp; stage-granular remat)
+                bmb = xt["bwd_mb"][stage]
+                x_b = lax.dynamic_index_in_dim(stash, bmb % sched.stash, 0, keepdims=False)
+                pos_b = stage_row(pos_mb, xt["bwd_mb"])
+                bias_b = stage_row(bias_mb, xt["bwd_mb"]) if has_bias else None
+
+                def run_bwd(g):
+                    def fb(ps, xx):
+                        if uniform_stages:
+                            return bodies[0](ps, xx, pos_b, bias_b)
+                        return lax.switch(stage, bodies, ps, xx, pos_b, bias_b)
+
+                    _, vjp = jax.vjp(fb, local, x_b)
+                    dps_, dx_ = vjp(g)
+                    # Pin the branch exit INSIDE the branch: partial/sharded
+                    # kernel grads -> within-stage-replicated. A reshard to
+                    # replicated only lowers to all-reduce / all-gather
+                    # (group-scoped), never an axis-reassigning
+                    # collective-permute; without this pin the ZeRO
+                    # grad-accumulator sharding propagates backward through
+                    # the scan and GSPMD plants an m_tp -> m_dp permute in
+                    # this divergent branch — the round-2 MULTICHIP deadlock.
+                    dps_ = [
+                        jax.tree.map(
+                            lambda a: S.constrain(a, mesh, S.replicated_spec(a.ndim)), t
+                        )
+                        for t in dps_
+                    ]
+                    return dps_, S.constrain(dx_, mesh, mb_spec)
+
+                def zero_bwd(g):
+                    return jax.tree.map(jnp.zeros_like, local), jnp.zeros_like(x_b)
+
+                if mask_not_branch:
+                    # masked cotangent -> exactly-zero grads for invalid slots
+                    dps, dx = run_bwd(g_in * xt["bwd_v"][stage].astype(act_dtype))
+                else:
+                    dps, dx = lax.cond(xt["bwd_v"][stage], run_bwd, zero_bwd, g_in)
+                sgrads = jax.tree.map(jnp.add, sgrads, dps)
+
+                # [uniform] head + loss for the microbatch whose last-stage
+                # forward ran the PREVIOUS tick (every stage runs it
+                # redundantly — the last stage is the critical path either
+                # way); its cotangent feeds the last stage's backward NEXT
+                # tick (bwd(j, pp-1) = head(j) + 1 by the slot equations)
+                e = xt["head_mb"]
+                ev = xt["head_v"].astype(jnp.float32)
+                labels_e = gather_mb(labels_mb, e)
+                mask_e = gather_mb(mask_mb, e) if has_mask else None
+                w_e = weights[jnp.clip(e, 0, chunks - 1)]
+                l_e, head_vjp = jax.vjp(
+                    lambda vp, yy: head_loss(vp, yy, labels_e, mask_e, w_e),
+                    vparams, y_exit,
+                )
+                dvp_head, dy_new = head_vjp(ev)
+                loss = loss + l_e * ev
+                vgrads = jax.tree.map(jnp.add, vgrads, dvp_head)
+
+                # [uniform] embedding backward for the microbatch whose
+                # stage-0 backward ran the PREVIOUS tick (its cotangent
+                # arrived via this tick's all-gather)
+                b0 = xt["emb_mb"]
+                b0v = xt["emb_v"].astype(act_dtype)
+                tok_b = gather_mb(inputs_mb, b0)
+                pos_bb = gather_mb(pos_mb, b0)
+                tti_b = gather_mb(tti_mb, b0) if has_tti else None
+                _, embed_vjp = jax.vjp(
+                    lambda vp: embed_fwd(vp, tok_b, pos_bb, tti_b).astype(act_dtype),
+                    vparams,
+                )
+                (dvp_embed,) = embed_vjp(dx0 * b0v)
+                vgrads = jax.tree.map(jnp.add, vgrads, dvp_embed)
+
+                return (
+                    y, dx, dy_new.astype(act_dtype), stash, loss, sgrads,
+                    vgrads,
+                ), None
+
+            # Order the scan's FIRST cross-stage all-gather after every
+            # shard_map boundary reshard (the vocab-params gather from the
+            # pp-sharded storage layout, batch-table replication): those
+            # reshards are cross-stage collectives in the uniform pre-loop
+            # region, but the first tick's all-gather consumes only zeros and
+            # would otherwise race them — the last deadlock shape found while
+            # driving this engine (stage-0 parked in the tick gather, the
+            # rest in the boundary permute).
+            deps = jax.tree.leaves(vparams) + jax.tree.leaves(
+                (inputs_mb, pos_mb, labels_mb, tti_mb, mask_mb, bias_mb, weights)
+            )
+            y0 = lax.optimization_barrier(
+                tuple([jnp.zeros((mb, Sq, H), act_dtype)] + deps)
+            )[0]
+            carry0 = (
+                y0,
+                jnp.zeros((mb, Sq, H), act_dtype),
+                jnp.zeros((mb, Sq, H), act_dtype),
+                jnp.zeros((sched.stash, mb, Sq, H), act_dtype),
+                jnp.zeros((), jnp.float32),
+                [jax.tree.map(jnp.zeros_like, t) for t in local],
+                jax.tree.map(jnp.zeros_like, vparams),
+            )
+            final, _ = lax.scan(tick, carry0, xs)
+            loss, sgrads, vgrads = final[4], final[5], final[6]
+            return (
+                loss,
+                [jax.tree.map(lambda a: a[None], t) for t in sgrads],
+                vgrads,
+            )
+
+        pp_specs = [jax.tree.map(lambda _: P(PP_AXIS), t) for t in stages]
+
+        def rep_tree(t):
+            return jax.tree.map(lambda _: P(), t)
+
+        smap = jax.shard_map(
+            schedule_body,
+            mesh=mesh,
+            in_specs=(
+                pp_specs,                     # stages: stacked across pp
+                rep_tree(vparams_stored),     # vocab layers: within-stage layout
+                P(), P(), P(), P(), P(), P(), P(),  # batch tables + weights
+                rep_tree(xs),                 # schedule tables
+            ),
+            out_specs=(P(), pp_specs, rep_tree(vparams_stored)),
+            axis_names={PP_AXIS},
+            check_vma=False,
         )
-        final, _ = lax.scan(tick, carry0, xs)
-        loss, sgrads, vgrads = final[4], final[5], final[6]
+
+        # Gather the vocab layers from their pp-sharded STORAGE layout
+        # (vocab_param_specs: vocab over ('pp',) + vocab_tp — state is
+        # 1/(pp*vtp) per device) into the within-stage layout the schedule
+        # computes in. This one cross-stage all-gather per step happens HERE,
+        # before any divergence, where it is safe.
+        base_specs = M.model_param_specs(cfg, hp)
+        vparams_local = jax.tree.map(
+            lambda sp, t: S.constrain(t, mesh, sp),
+            {k: base_specs[k] for k in vparams_stored}, vparams_stored,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        loss, sgrads, vgrads = smap(
+            stages, vparams_local, inputs_mb, pos_mb, labels_mb,
+            tti_mb, mask_mb, bias_mb, weights, xs,
+        )
         grads = dict(vgrads)
         grads["stages"] = sgrads
         return loss, grads
 
     return loss_and_grad
+
+
+# ============================================================ divergence guard
+def assert_no_divergent_global_collectives(hlo_text: str) -> None:
+    """Compile-time deadlock guard for the 1F1B schedule.
+
+    The schedule's per-stage `lax.cond`/`lax.switch` branches (the TPU path;
+    the CPU path masks instead of branching) execute on only a subset of
+    devices, but XLA's collective-permute rendezvous (rendezvous.cc) spans
+    every device in the computation — a GSPMD resharding permute inside a
+    branch therefore hangs the step on CPU and is conservatively unsafe on
+    TPU. Group-scoped collectives (all-reduce / all-gather / reduce-scatter /
+    grouped all-to-all over within-stage axes) are fine on TPU: collectives
+    are matched statically per replica group, and branch predicates only vary
+    across stages, never within one. This scans *optimized* HLO (GSPMD runs
+    at compile time) and fails loudly instead of letting a future config
+    deadlock at runtime. The engine's only hand-placed cross-stage collective
+    (the per-tick all-gather) is uniform code, not under `/cond/`, and is
+    excluded."""
+    bad = []
+    for line in hlo_text.splitlines():
+        if "collective-permute" not in line:
+            continue
+        if "op_name=" not in line or "/cond/" not in line.split("op_name=", 1)[1]:
+            continue
+        bad.append(line.strip()[:240])
+    if bad:
+        raise RuntimeError(
+            "collective-permute inside a stage-divergent branch (would deadlock "
+            "across pipeline stages):\n" + "\n".join(bad)
+        )
+
+
+def compile_and_check(step_fn, *example_args):
+    """Lower + compile a train step and run the divergence guard on the result.
+    Returns the compiled executable (so callers pay compilation only once)."""
+    compiled = jax.jit(step_fn).lower(*example_args).compile() if not hasattr(
+        step_fn, "lower"
+    ) else step_fn.lower(*example_args).compile()
+    assert_no_divergent_global_collectives(compiled.as_text())
+    return compiled

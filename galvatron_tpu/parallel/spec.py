@@ -118,3 +118,51 @@ def constrain(x, mesh: Mesh, spec: P):
     """Reshard an activation to `spec` — the XLA-native Module_with_relocation
     (reference parallel.py:279-313): collectives are inserted by the compiler."""
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def _entry_axes(e: Axes) -> Tuple[str, ...]:
+    if e is None:
+        return ()
+    if isinstance(e, str):
+        return (e,)
+    return tuple(e)
+
+
+def meet_spec(a: P, b: P, ndim: int) -> P:
+    """Per-dim longest common prefix of two PartitionSpecs.
+
+    Resharding a -> meet -> b is *axis-monotone*: every step only drops or
+    appends trailing mesh axes on each dim, so XLA lowers it with group-scoped
+    collectives (all-gather / slice) and never an axis-reassigning
+    collective-permute. That property is what makes heterogeneous per-layer
+    reshards safe inside the 1F1B schedule's stage-divergent branches, where a
+    collective-permute (whose XLA rendezvous spans ALL devices) would deadlock
+    across stages running different branches."""
+    ea = list(a) + [None] * (ndim - len(a))
+    eb = list(b) + [None] * (ndim - len(b))
+    out = []
+    for xa, xb in zip(ea, eb):
+        ta, tb = _entry_axes(xa), _entry_axes(xb)
+        common = []
+        for i in range(min(len(ta), len(tb))):
+            if ta[i] != tb[i]:
+                break
+            common.append(ta[i])
+        out.append(_ax(common))
+    return P(*out)
+
+
+def monotone_constrain(x, mesh: Mesh, from_spec: P, to_spec: P):
+    """Constrain `x` (currently sharded as `from_spec`) to `to_spec`, routing
+    through the per-dim meet when the direct transition would reassign a dim
+    between different mesh axes. Trace-time decision: when the transition is
+    already nested (meet equals one endpoint) no extra constraint is emitted."""
+    meet = meet_spec(from_spec, to_spec, x.ndim)
+    norm = lambda s: tuple(list(s) + [None] * (x.ndim - len(s)))
+    if norm(meet) not in (norm(from_spec), norm(to_spec)):
+        x = constrain(x, mesh, meet)
+    return constrain(x, mesh, to_spec)
+
+
+def replicated_spec(ndim: int) -> P:
+    return P(*([None] * ndim))

@@ -150,11 +150,25 @@ class HybridParallelModel:
 
             if self.grad_fn is not None:
                 # 1f1b pipeline: loss and grads come out of the hand-written
-                # warmup/steady/cooldown schedule in one pass.
+                # warmup/steady/cooldown schedule in one pass. The reshard to
+                # accumulator shardings happens HERE, outside the schedule's
+                # scan, so no ZeRO dp-sharding constraint can propagate into
+                # its stage-divergent branches; the per-leaf reshards are
+                # chained so independent global collectives cannot be entered
+                # in different orders by stages whose executor timelines
+                # diverged in the schedule (see the divergence-safety notes in
+                # pipeline_1f1b.make_loss_and_grad).
                 loss, grads = self.grad_fn(params, batch)
-                grads = jax.tree.map(
-                    lambda g, s: jax.lax.with_sharding_constraint(g, s), grads, accum_shardings
-                )
+                leaves, treedef = jax.tree.flatten(grads)
+                slvs = jax.tree.leaves(accum_shardings, is_leaf=lambda x: isinstance(x, NamedSharding))
+                out, prev = [], None
+                for g, s in zip(leaves, slvs, strict=True):
+                    if prev is not None:
+                        g = jax.lax.optimization_barrier((g, prev))[0]
+                    g = jax.lax.with_sharding_constraint(g, s)
+                    out.append(g)
+                    prev = g
+                grads = jax.tree.unflatten(treedef, out)
             elif chunks == 1:
                 loss, grads = jax.value_and_grad(mb_loss)(params, batch)
                 grads = jax.tree.map(

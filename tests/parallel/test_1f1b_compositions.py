@@ -1,0 +1,143 @@
+"""1F1B strategy-composition coverage — the class of bug that kept the round-1/2
+multichip gates red.
+
+Round-2 postmortem: the external gate's exact config (llama, pp=2, a layer with
+fsdp+checkpoint AND a ulysses-sp layer per stage, vocab_tp=2, zero2) appeared
+in no pytest, and it deadlocked: the ZeRO grad-accumulator sharding constraint
+propagated into the 1F1B schedule's stage-divergent `lax.cond` branches, where
+GSPMD planted an axis-reassigning collective-permute whose XLA rendezvous spans
+every device — stages running the other branch never arrive. Bisection (kept
+here as test cases): the trigger is the sp layer's dense-kernel partial grads
+meeting the dp-sharded accumulator, NOT fsdp+ckpt on one layer.
+
+These tests (a) run the gate's exact config end-to-end, (b) run the bisection
+probes, and (c) assert the compile-time guard finds no collective-permute
+inside divergent branches for every composition."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from galvatron_tpu.config.strategy import HybridParallelConfig, LayerStrategy
+from galvatron_tpu.parallel.pipeline_1f1b import compile_and_check
+from galvatron_tpu.models.llama import llama_config
+from galvatron_tpu.runtime.dataloader import prepare_batch
+from galvatron_tpu.runtime.model_api import construct_hybrid_parallel_model
+from galvatron_tpu.runtime.optimizer import OptimizerArgs, get_optimizer_and_scheduler
+
+pytestmark = [pytest.mark.parallel, pytest.mark.distributed]
+
+EXTENDED = bool(os.environ.get("GALVATRON_EXTENDED_TESTS"))
+
+
+def _build(stage_layers, devices, *, pp=2, vocab_tp=2, chunks=2, seq=32,
+           default_dp_type="zero2", vocab_sp=0, num_kv_heads=None, global_bsz=4):
+    layers = list(stage_layers) * pp
+    hp = HybridParallelConfig(
+        world_size=8, pp=pp, layers=layers, global_bsz=global_bsz, chunks=chunks,
+        default_dp_type=default_dp_type, vocab_tp=vocab_tp, vocab_sp=vocab_sp,
+        pipeline_type="pipedream_flush",
+    )
+    cfg = llama_config(
+        "llama-0.3b", num_layers=len(layers), hidden_size=64, num_heads=4,
+        vocab_size=256, max_seq_len=seq, compute_dtype=jnp.float32,
+        **({"num_kv_heads": num_kv_heads} if num_kv_heads else {}),
+    )
+    m = construct_hybrid_parallel_model(cfg, hp, devices)
+    tokens = np.random.RandomState(0).randint(0, cfg.vocab_size, (global_bsz, seq))
+    batch = m.shard_batch(prepare_batch(hp, tokens))
+    return m, batch
+
+
+def _compile_step(m, batch):
+    params = m.init_params(jax.random.PRNGKey(0))
+    tx, _ = get_optimizer_and_scheduler(OptimizerArgs(lr=1e-3, warmup_steps=1, total_steps=4))
+    opt_state = m.init_opt_state(tx, params)
+    compiled = compile_and_check(m.make_train_step(tx), params, opt_state, batch)
+    return compiled, params, opt_state
+
+
+def test_multichip_gate_config(devices8):
+    """The EXACT __graft_entry__.dryrun_multichip(8) config, executed: the
+    round-2 deadlock (MULTICHIP_r02.json ok=false). Whatever the external gate
+    runs must be a pytest first."""
+    stage = [LayerStrategy(tp=2, fsdp=1, checkpoint=1), LayerStrategy(tp=2, sp=1)]
+    m, batch = _build(stage, devices8)
+    compiled, params, opt_state = _compile_step(m, batch)
+    params, opt_state, metrics = compiled(params, opt_state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_gpt_learned_positions_with_sp(devices8):
+    """GPT (learned positions, biases, fused qkv) through the 1F1B schedule
+    with a ulysses-sp layer — the composition that exposed the round-3
+    rendezvous deadlocks (branch-validity-divergent grouped collectives and
+    the scatter-add embedding backward). Loss must drop while memorizing one
+    batch."""
+    import jax.numpy as jnp
+
+    from galvatron_tpu.models.gpt import gpt_config
+
+    cfg = gpt_config("gpt-0.3b", num_layers=4, hidden_size=64, num_heads=4,
+                     vocab_size=256, compute_dtype=jnp.float32)
+    hp = HybridParallelConfig(
+        world_size=8, pp=2,
+        layers=[LayerStrategy(tp=2, fsdp=1, checkpoint=1), LayerStrategy(tp=2, sp=1)] * 2,
+        global_bsz=8, chunks=2, default_dp_type="zero2", vocab_tp=2,
+        pipeline_type="pipedream_flush",
+    )
+    m = construct_hybrid_parallel_model(cfg, hp, devices8)
+    params = m.init_params(jax.random.PRNGKey(0))
+    tx, _ = get_optimizer_and_scheduler(
+        OptimizerArgs(lr=3e-3, warmup_steps=1, total_steps=20)
+    )
+    opt_state = m.init_opt_state(tx, params)
+    step = m.make_train_step(tx)
+    tokens = np.random.RandomState(0).randint(0, cfg.vocab_size, (8, 32))
+    batch = m.shard_batch(prepare_batch(hp, tokens))
+    losses = []
+    for _ in range(4):
+        params, opt_state, metrics = step(params, opt_state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0], losses
+
+
+def test_bisect_probe_sp_without_fsdp(devices8):
+    """Bisection probe: sp kept, fsdp+ckpt removed — this variant deadlocked
+    pre-fix, refuting the 'ZeRO-3 + remat on one layer' diagnosis."""
+    stage = [LayerStrategy(tp=2), LayerStrategy(tp=2, sp=1)]
+    m, batch = _build(stage, devices8)
+    compiled, params, opt_state = _compile_step(m, batch)
+    params, opt_state, metrics = compiled(params, opt_state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+
+
+@pytest.mark.skipif(not EXTENDED, reason="set GALVATRON_EXTENDED_TESTS=1 for the full matrix")
+@pytest.mark.parametrize(
+    "name,stage,kw",
+    [
+        ("fsdp_ckpt_no_sp", [LayerStrategy(tp=2, fsdp=1, checkpoint=1), LayerStrategy(tp=2)], {}),
+        ("sp_both_layers", [LayerStrategy(tp=2, sp=1), LayerStrategy(tp=2, sp=1)], {}),
+        ("sp_fsdp_ckpt_same_layer", [LayerStrategy(tp=2, sp=1, fsdp=1, checkpoint=1),
+                                     LayerStrategy(tp=2)], {}),
+        ("gqa_sp", [LayerStrategy(tp=2, sp=1), LayerStrategy(tp=2)], {"num_kv_heads": 2}),
+        ("chunks_over_pp", [LayerStrategy(tp=2), LayerStrategy(tp=2, sp=1)],
+         {"chunks": 4, "global_bsz": 8}),
+        ("vocab_sp", [LayerStrategy(tp=2, sp=1), LayerStrategy(tp=2, sp=1)], {"vocab_sp": 1}),
+        ("mixed_tp_degrees", [LayerStrategy(tp=2), LayerStrategy(tp=1, fsdp=1)],
+         {"global_bsz": 8}),
+        ("zero3_default", [LayerStrategy(tp=2, sp=1), LayerStrategy(tp=2)],
+         {"default_dp_type": "zero3"}),
+    ],
+)
+def test_composition_matrix(devices8, name, stage, kw):
+    """Extended matrix: compile + divergence guard + one executed step for every
+    composition the search can emit under 1F1B."""
+    m, batch = _build(stage, devices8, **kw)
+    compiled, params, opt_state = _compile_step(m, batch)
+    params, opt_state, metrics = compiled(params, opt_state, batch)
+    assert np.isfinite(float(metrics["loss"]))
