@@ -31,6 +31,9 @@ from galvatron_tpu.parallel.mesh import LayerAxes, layer_axes
 Params = Dict[str, Any]
 
 META_CONFIGS = {
+    # smoke tier: CI / dryrun shapes (compiles in seconds on one core)
+    "swin-test": dict(embed_dim=32, depths=(1, 1, 2, 1), num_heads=(2, 2, 2, 2),
+                      image_size=64, window=4, num_classes=10),
     "swin-tiny": dict(embed_dim=96, depths=(2, 2, 6, 2), num_heads=(3, 6, 12, 24)),
     "swin-base": dict(embed_dim=128, depths=(2, 2, 18, 2), num_heads=(4, 8, 16, 32)),
     "swin-large": dict(embed_dim=192, depths=(2, 2, 18, 2), num_heads=(6, 12, 24, 48)),

@@ -27,11 +27,14 @@ from galvatron_tpu.config.strategy import HybridParallelConfig
 from galvatron_tpu.ops.attention import core_attention
 from galvatron_tpu.ops.norms import rms_norm
 from galvatron_tpu.parallel import spec as S
-from galvatron_tpu.parallel.mesh import LayerAxes, layer_axes, vocab_axes
+from galvatron_tpu.parallel.mesh import PP_AXIS, LayerAxes, layer_axes, vocab_axes
 
 Params = Dict[str, Any]
 
 META_CONFIGS = {
+    # smoke tier: CI / dryrun shapes (compiles in seconds on one core)
+    "t5-test": dict(hidden_size=64, num_heads=4, num_enc_layers=2, num_dec_layers=2,
+                    head_dim=16, ffn_hidden=128, vocab_size=512),
     "t5-small": dict(hidden_size=512, num_heads=8, num_enc_layers=6, num_dec_layers=6,
                      head_dim=64, ffn_hidden=2048),
     "t5-base": dict(hidden_size=768, num_heads=12, num_enc_layers=12, num_dec_layers=12,
@@ -451,9 +454,55 @@ def convert_hf_t5(state_dict: Dict[str, Any], cfg: T5Config) -> Params:
 
 
 # ================================================================ constructor
+def t5_vocab_pipeline_specs(cfg: T5Config, hp: HybridParallelConfig, *, storage: bool) -> Params:
+    """Specs for the non-stage params under the enc-dec pipeline.
+    storage=True: the wte vocab dim shards over ('pp',) + vocab_tp (state is
+    1/(pp*vtp) per device, cf. pipeline_1f1b.vocab_param_specs); False: the
+    within-stage layout the schedule computes in."""
+    vax = vocab_axes(hp)
+    vocab_ax = S._ax(((PP_AXIS,) if storage else ()) + (() if vax.ulysses else tuple(vax.tp)))
+    z3 = S._ax(vax.dp) if vax.zero3 else None
+    specs: Params = {
+        "embed": {"wte": P(vocab_ax, z3)},
+        "dec_norm": {"scale": P(None)},
+    }
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = {"kernel": P(z3, vocab_ax)}
+    return specs
+
+
+def t5_pad_batch(batch: Params) -> Params:
+    """Pad encoder and decoder streams to a common sequence length (the
+    pipeline channel is one static shape); padded encoder keys are masked via
+    attn_mask, padded decoder positions via loss_mask."""
+    se = batch["tokens"].shape[1]
+    sd = batch["dec_tokens"].shape[1]
+    if se == sd:
+        return batch
+    Sq = max(se, sd)
+    b = dict(batch)
+    B = batch["tokens"].shape[0]
+    if se < Sq:
+        pad = Sq - se
+        b["tokens"] = jnp.pad(batch["tokens"], ((0, 0), (0, pad)))
+        mask = batch.get("attn_mask")
+        mask = mask if mask is not None else jnp.ones((B, se), jnp.float32)
+        b["attn_mask"] = jnp.pad(mask, ((0, 0), (0, pad)))
+    if sd < Sq:
+        pad = Sq - sd
+        b["dec_tokens"] = jnp.pad(batch["dec_tokens"], ((0, 0), (0, pad)))
+        b["labels"] = jnp.pad(batch["labels"], ((0, 0), (0, pad)))
+        lmask = batch.get("loss_mask")
+        lmask = lmask if lmask is not None else jnp.ones((B, sd), jnp.float32)
+        b["loss_mask"] = jnp.pad(lmask, ((0, 0), (0, pad)))
+    return b
+
+
 def construct_t5_model(cfg: T5Config, hp: HybridParallelConfig, devices=None):
     """Family-specific build (ModelFamily.build hook): two-layer-type param
-    tree with per-layer strategies over enc+dec."""
+    tree with per-layer strategies over enc+dec; pp>1 runs the enc-dec 1F1B
+    schedule (parallel/pipeline_1f1b_encdec.py — the reference's
+    multi-tensor-send T5 pipeline, pipeline.py:1442-1580)."""
     from galvatron_tpu.parallel.mesh import build_mesh
     from galvatron_tpu.runtime.model_api import HybridParallelModel
 
@@ -462,9 +511,39 @@ def construct_t5_model(cfg: T5Config, hp: HybridParallelConfig, devices=None):
             "hp covers %d layers but t5 has %d (enc %d + dec %d)"
             % (len(hp.layers), cfg.num_layers, cfg.num_enc_layers, cfg.num_dec_layers)
         )
-    if hp.pp > 1:
-        raise NotImplementedError("t5 pipeline parallelism lands with the enc-dec stage pipeline")
     mesh = build_mesh(hp, devices)
+    if hp.pp > 1:
+        from galvatron_tpu.parallel.pipeline_1f1b_encdec import (
+            make_encdec_loss_and_grad,
+            stack_t5_layer_specs,
+            stack_t5_params,
+            validate_encdec_config,
+        )
+
+        validate_encdec_config(cfg, hp)
+        specs = t5_vocab_pipeline_specs(cfg, hp, storage=True)
+        specs["stages"] = stack_t5_layer_specs(cfg, hp)
+        raw_grad_fn = make_encdec_loss_and_grad(cfg, hp, mesh)
+        grad_fn = lambda p, b: raw_grad_fn(p, t5_pad_batch(b))
+
+        def init_fn(rng):
+            canonical = init_t5_params(rng, cfg)
+            out = {"embed": canonical["embed"], "dec_norm": canonical["dec_norm"]}
+            if not cfg.tie_embeddings:
+                out["lm_head"] = canonical["lm_head"]
+            out["stages"] = stack_t5_params(canonical, cfg, hp)
+            return out
+
+        return HybridParallelModel(
+            cfg=cfg,
+            hp=hp,
+            mesh=mesh,
+            param_specs=specs,
+            loss_fn=lambda p, b: grad_fn(p, b)[0],
+            forward_fn=None,
+            init_fn=init_fn,
+            grad_fn=grad_fn,
+        )
     return HybridParallelModel(
         cfg=cfg,
         hp=hp,

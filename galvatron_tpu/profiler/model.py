@@ -228,6 +228,70 @@ class ModelProfiler:
         per_layer = (b_hi - b_lo - 2 * extra_params) / (hi - lo)
         return max(per_layer / bsz, 1024.0)
 
+    def _act_bytes_tp(self, t: int, bsz: int, seq: int, k: int) -> Optional[float]:
+        """MEASURED per-device activation bytes per layer per sample at tp=k:
+        compile the layer-stack gradient over a k-device mesh with the
+        runtime's own shardings (weight partitioning plus megatron-sp
+        activation sharding) and difference the compiled per-device peaks.
+        Replaces the act(1)/k derivation — attention under megatron-sp
+        gathers full-sequence tensors whose footprint does NOT divide by k
+        (the reference measures per-tp for the same reason,
+        model_profiler.py:374-559). Returns None when fewer than k local
+        devices exist (single-chip profiling falls back to the derivation)."""
+        if k <= 1 or len(jax.devices()) < k:
+            return None
+        if not isinstance(self.cfg, M.TransformerConfig):
+            # t5/swin build their own layer stacks (subclass _stack_t); their
+            # per-tp measurement falls back to the derivation for now
+            return None
+        from jax.sharding import PartitionSpec as P
+
+        from galvatron_tpu.config.strategy import HybridParallelConfig
+        from galvatron_tpu.models.base import layer_param_specs
+        from galvatron_tpu.parallel import spec as S
+        from galvatron_tpu.parallel.mesh import build_mesh, layer_axes
+
+        a = self.args
+        lo, hi = a.layernum_min, a.layernum_max
+
+        def grad_prog(n):
+            cfg = dataclasses.replace(self.cfg, num_layers=max(n, 1))
+            hp = HybridParallelConfig.uniform(k, max(n, 1), tp=k, global_bsz=bsz)
+            mesh = build_mesh(hp, jax.devices()[:k])
+            keys = jax.random.split(jax.random.PRNGKey(0), max(n, 1))
+            layers = [M.init_layer_params(kk, cfg) for kk in keys[:n]]
+            axes = [layer_axes(hp, j) for j in range(n)]
+            layers = [
+                jax.device_put(lp, jax.tree.map(
+                    lambda sp: S.named(mesh, sp), layer_param_specs(cfg, ax),
+                    is_leaf=lambda v: isinstance(v, P),
+                ))
+                for lp, ax in zip(layers, axes)
+            ]
+            x = jax.random.normal(jax.random.PRNGKey(1), (bsz, seq, cfg.hidden_size), self._dtype)
+            positions = jnp.broadcast_to(jnp.arange(seq), (bsz, seq))
+
+            def fwd(layers, x):
+                for j, lp in enumerate(layers):
+                    ax = axes[j]
+                    x = S.constrain(x, mesh, S.act_spec(ax))
+                    x = M.layer_forward(lp, x, positions, cfg, mesh=mesh, axes=ax)
+                return jnp.sum(x.astype(jnp.float32))
+
+            # per-device bytes of the grad outputs, from the actual shardings
+            shard_bytes = sum(
+                leaf.nbytes // max(len(leaf.sharding.device_set), 1)
+                for lp in layers for leaf in jax.tree.leaves(lp)
+            )
+            return (lambda ls, xx: jax.grad(fwd)(ls, xx)), (layers, x), shard_bytes
+
+        g_lo, args_lo, p_lo = grad_prog(lo)
+        g_hi, args_hi, p_hi = grad_prog(hi)
+        b_lo = _compiled_peak_bytes(g_lo, args_lo)
+        b_hi = _compiled_peak_bytes(g_hi, args_hi)
+        per_layer = (b_hi - b_lo - 2 * (p_hi - p_lo)) / (hi - lo)
+        return max(per_layer / bsz, 1024.0)
+
     def _other_ms_per_sample(self, bsz: int, seq: int, per_layer_ms_sum: float) -> float:
         """Embedding + head + loss time: full tiny model minus its layers'
         share (reference separates this as 'other_time')."""
@@ -290,7 +354,13 @@ class ModelProfiler:
             param_mb = self._layer_param_bytes(lt) / MB
             act1 = self._act_bytes(lt, bsz, seq, remat=False) / MB
             act_ckpt = self._act_bytes(lt, bsz, seq, remat=True) / MB
-            tp_act = {k: round(act1 / k, 3) for k in tps}
+            # tp>1 entries are MEASURED on a k-device mesh when the machine
+            # has one (tests, multi-chip); a single-chip profile falls back to
+            # the act(1)/k derivation
+            tp_act = {}
+            for k in tps:
+                measured = self._act_bytes_tp(lt, bsz, seq, k) if k > 1 else None
+                tp_act[k] = round(measured / MB if measured else act1 / k, 3)
             tp_act["checkpoint"] = round(min(act_ckpt, act1), 3)
             out["layertype_%d" % lt] = {
                 "parameter_size": round(param_mb, 3),

@@ -93,7 +93,7 @@ def predict_memory_mb(
             model_args=ma,
             train_args=TrainArgs(mixed_precision=mixed_precision,
                                  runtime_context_mem=0.0),
-            parallel_args=ParallelArgs(chunks=hp.chunks),
+            parallel_args=ParallelArgs(chunks=hp.chunks, pipeline_type=hp.pipeline_type),
             profile_model_args=pma,
         )
         cost = m.get_memory_cost()

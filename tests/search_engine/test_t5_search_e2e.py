@@ -18,7 +18,7 @@ def test_t5_profile_search_train(tmp_path, devices8):
     from galvatron_tpu.cli.profile import main_model
 
     res = main_model(
-        ["--model_type", "t5", "--model_size", "t5-small",
+        ["--model_type", "t5", "--model_size", "t5-test",
          "--profile_batch_size", "1", "--layernum_min", "1", "--layernum_max", "2",
          "--mixed_precision", "bf16", "--config_dir", d] + SEQ_ARGS
     )
@@ -40,18 +40,18 @@ def test_t5_profile_search_train(tmp_path, devices8):
 
     strategy_path = os.path.join(d, "t5_strategy.json")
     res = search_main(
-        ["--model_type", "t5", "--model_size", "t5-small", "--config_dir", d,
-         "--memory_constraint", "8", "--max_pp_deg_search", "1",
+        ["--model_type", "t5", "--model_size", "t5-test", "--config_dir", d,
+         "--memory_constraint", "8", "--max_pp_deg_search", "2",
          "--max_tp_deg_search", "2", "--settle_bsz", "8", "--mixed_precision",
          "bf16", "--output_config_path", strategy_path] + SEQ_ARGS
     )
-    assert res["strategies"] is not None and len(res["strategies"]) == 12
+    assert res["strategies"] is not None and len(res["strategies"]) == 4  # t5-test: 2 enc + 2 dec
     assert os.path.exists(strategy_path)
 
     from galvatron_tpu.cli.train import main as train_main
 
     s = train_main(
-        ["--model_type", "t5", "--model_size", "t5-small",
+        ["--model_type", "t5", "--model_size", "t5-test",
          "--galvatron_config_path", strategy_path,
          "--train_iters", "2", "--lr", "1e-4", "--mixed_precision", "bf16"] + SEQ_ARGS
     )
