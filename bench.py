@@ -1,20 +1,28 @@
-"""Benchmark: transformer-layer forward time on the real TPU chip.
+"""Benchmark on the real TPU chip: reference layer-forward parity + the
+project's north-star training-throughput metrics.
 
-Metric matches the one concrete number the reference ships (BASELINE.md):
-GPT layer (hidden=4096, heads=32, seq=2048, bf16) forward time per layer per
-sample = 5.331 ms on the authors' GPU
+Primary metric (vs_baseline) matches the one concrete number the reference
+ships (BASELINE.md): GPT layer (hidden=4096, heads=32, seq=2048, bf16)
+forward time per layer per sample = 5.331 ms on the authors' GPU
 (reference: models/gpt_hf/configs/computation_profiling_bf16_hidden4096_head32_seqlen2048.json).
-
 Methodology mirrors the reference profiler's layer differencing
-(model_profiler.py:328-372): time N_hi and N_lo layer stacks, per-layer time
-= (T_hi - T_lo) / (N_hi - N_lo) / batch_size.
+(model_profiler.py:328-372). Robustness: ROUNDS independent measurement
+rounds, each a median of ITERS timed calls; the reported value is the MIN
+round (timing noise is strictly additive — the min is the best estimate of
+the kernel's true cost, cf. python timeit) and the cross-round spread is
+reported so a noisy host is visible instead of silently flipping
+vs_baseline.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-vs_baseline = reference_ms / measured_ms (>1 = faster than the reference's
-GPU measurement).
+North-star extras (BASELINE.json): a FULL train step — forward + backward +
+adam — on LLaMA-7B layer shapes (hidden 4096, ffn 11008, 32 heads, seq 2048,
+bf16 compute / fp32 adam), reported as tokens/sec/chip and MFU against the
+chip's peak bf16 matmul throughput.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
 """
 
 import json
+import os
 import time
 
 import numpy as np
@@ -24,12 +32,47 @@ import jax.numpy as jnp
 
 REFERENCE_MS_PER_LAYER_PER_SAMPLE = 5.331
 
-HIDDEN, HEADS, SEQ = 4096, 32, 2048
-BATCH = 8
+SMOKE = bool(os.environ.get("GALVATRON_BENCH_SMOKE"))
+
+# GPT layer-forward parity config (the reference's measured layer)
+HIDDEN, HEADS, SEQ = (512, 8, 256) if SMOKE else (4096, 32, 2048)
+BATCH = 2 if SMOKE else 8
 N_LO, N_HI = 1, 3
-WARMUP, ITERS = 3, 10
+WARMUP, ITERS, ROUNDS = (1, 3, 2) if SMOKE else (3, 10, 5)
+
+# LLaMA-7B layer shapes for the train-step metric
+L7B_HIDDEN, L7B_FFN, L7B_HEADS, L7B_SEQ = (512, 1376, 8, 256) if SMOKE else (4096, 11008, 32, 2048)
+L7B_LAYERS = 2 if SMOKE else 4
+L7B_BATCH = 1 if SMOKE else 4
+
+# peak dense bf16 matmul throughput per chip, FLOP/s
+PEAK_FLOPS_BY_KIND = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+    "TPU7x": 2307e12,
+}
 
 
+def _peak_flops():
+    kind = jax.devices()[0].device_kind
+    for k, v in PEAK_FLOPS_BY_KIND.items():
+        if kind.lower().startswith(k.lower()):
+            return v, kind
+    return None, kind
+
+
+def _sync(x):
+    # NB: block_until_ready does not reliably block on the experimental axon
+    # tunnel backend; a host transfer of a scalar does.
+    return float(jnp.sum(jax.tree.leaves(x)[0].astype(jnp.float32)))
+
+
+# ------------------------------------------------------- layer-forward parity
 def build_stack(n_layers):
     from galvatron_tpu.models import base as M
 
@@ -53,10 +96,7 @@ def build_stack(n_layers):
     return jax.jit(fwd), layers, x
 
 
-def time_stack(n_layers):
-    fwd, layers, x = build_stack(n_layers)
-    # NB: block_until_ready does not reliably block on the experimental axon
-    # tunnel backend; a host transfer of the scalar result does.
+def time_stack(fwd, layers, x):
     for _ in range(WARMUP):
         float(fwd(layers, x))
     times = []
@@ -67,17 +107,109 @@ def time_stack(n_layers):
     return float(np.median(times))
 
 
+def layer_fwd_metric():
+    f_lo, l_lo, x_lo = build_stack(N_LO)
+    f_hi, l_hi, x_hi = build_stack(N_HI)
+    per_round = []
+    for _ in range(ROUNDS):
+        t_lo = time_stack(f_lo, l_lo, x_lo)
+        t_hi = time_stack(f_hi, l_hi, x_hi)
+        per_round.append((t_hi - t_lo) / (N_HI - N_LO) / BATCH * 1e3)
+    best = float(np.min(per_round))
+    med = float(np.median(per_round))
+    spread = float((np.max(per_round) - np.min(per_round)) / max(med, 1e-9))
+    return best, med, spread
+
+
+# ------------------------------------------------- LLaMA-7B-layer train step
+def train_step_metric():
+    import optax
+
+    from galvatron_tpu.models import base as M
+
+    cfg = M.TransformerConfig(
+        hidden_size=L7B_HIDDEN, num_heads=L7B_HEADS, num_layers=L7B_LAYERS,
+        ffn_hidden=L7B_FFN, vocab_size=256, max_seq_len=L7B_SEQ,
+        norm_type="rmsnorm", activation="swiglu", position_type="rope",
+        qkv_bias=False, mlp_bias=False, out_bias=False,
+        compute_dtype=jnp.bfloat16, param_dtype=jnp.float32,
+    )
+    key = jax.random.PRNGKey(0)
+    layers = [M.init_layer_params(k, cfg) for k in jax.random.split(key, L7B_LAYERS)]
+    x = jax.random.normal(jax.random.PRNGKey(1), (L7B_BATCH, L7B_SEQ, L7B_HIDDEN), jnp.bfloat16)
+    positions = jnp.broadcast_to(jnp.arange(L7B_SEQ), (L7B_BATCH, L7B_SEQ))
+    tx = optax.adam(1e-4)
+    opt_state = tx.init(layers)
+
+    def loss_fn(layers, x):
+        y = x
+        for lp in layers:
+            y = M.layer_forward(lp, y, positions, cfg)
+        return jnp.mean(y.astype(jnp.float32) ** 2)
+
+    @jax.jit
+    def step(layers, opt_state, x):
+        loss, grads = jax.value_and_grad(loss_fn)(layers, x)
+        updates, opt_state = tx.update(grads, opt_state, layers)
+        layers = optax.apply_updates(layers, updates)
+        return layers, opt_state, loss
+
+    # warmup (compile + first run)
+    layers, opt_state, loss = step(layers, opt_state, x)
+    _sync(loss)
+    rounds = []
+    for _ in range(ROUNDS):
+        times = []
+        for _ in range(max(ITERS // 2, 2)):
+            t0 = time.perf_counter()
+            layers, opt_state, loss = step(layers, opt_state, x)
+            _sync(loss)
+            times.append(time.perf_counter() - t0)
+        rounds.append(float(np.median(times)))
+    step_s = float(np.min(rounds))
+
+    tokens = L7B_BATCH * L7B_SEQ
+    n_params = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(layers))
+    # model FLOPs: 6 * params * tokens (fwd 2x + bwd 4x) + causal attention
+    # 12 * L * S * H * tokens * 0.5 (PaLM appendix-B convention)
+    flops = 6.0 * n_params * tokens + 12 * L7B_LAYERS * L7B_SEQ * L7B_HIDDEN * tokens * 0.5
+    peak, kind = _peak_flops()
+    tokens_per_sec = tokens / step_s
+    mfu = (flops / step_s / peak) if peak else None
+    return {
+        "config": "llama7b_layer_stack%d_seq%d_bf16_adam" % (L7B_LAYERS, L7B_SEQ),
+        "step_ms": round(step_s * 1e3, 3),
+        "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "device_kind": kind,
+        "params": n_params,
+    }
+
+
 def main():
-    t_lo = time_stack(N_LO)
-    t_hi = time_stack(N_HI)
-    per_layer_per_sample_ms = (t_hi - t_lo) / (N_HI - N_LO) / BATCH * 1e3
+    best, med, spread = layer_fwd_metric()
+    extra = {
+        "layer_fwd_ms_median": round(med, 4),
+        "layer_fwd_round_spread": round(spread, 4),
+        "rounds": ROUNDS,
+        "train_step": train_step_metric(),
+    }
+    metric = (
+        "SMOKE_gpt_layer_fwd_ms_h%d_s%d" % (HIDDEN, SEQ)
+        if SMOKE else "gpt_layer_fwd_ms_per_layer_per_sample_h4096_s2048_bf16"
+    )
     print(
         json.dumps(
             {
-                "metric": "gpt_layer_fwd_ms_per_layer_per_sample_h4096_s2048_bf16",
-                "value": round(per_layer_per_sample_ms, 4),
+                "metric": metric,
+                "value": round(best, 4),
                 "unit": "ms",
-                "vs_baseline": round(REFERENCE_MS_PER_LAYER_PER_SAMPLE / per_layer_per_sample_ms, 4),
+                # the baseline is the full-shape reference number; a smoke run
+                # measures different shapes and must not claim a ratio
+                "vs_baseline": None if SMOKE else round(
+                    REFERENCE_MS_PER_LAYER_PER_SAMPLE / best, 4
+                ),
+                "extra": extra,
             }
         )
     )

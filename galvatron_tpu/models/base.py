@@ -281,13 +281,10 @@ def layer_forward(
     if axes is not None and mesh is not None and len(axes.cp) > 0:
         from galvatron_tpu.ops.ring_attention import ring_attention
 
-        if attn_bias is not None:
-            raise NotImplementedError(
-                "attention bias / padding masks are not supported under context "
-                "parallelism (the reference's zigzag ring path is causal-only, "
-                "transformer.py:2335-2670)"
-            )
-        attn = ring_attention(q, k, v, positions, mesh=mesh, axes=axes, causal=cfg.causal)
+        attn = ring_attention(
+            q, k, v, positions, mesh=mesh, axes=axes, causal=cfg.causal,
+            bias=attn_bias,
+        )
     else:
         attn = core_attention(q, k, v, causal=cfg.causal, bias=attn_bias, impl=cfg.attn_impl)
     attn = attn.reshape(attn.shape[0], attn.shape[1], cfg.num_heads * cfg.head_dim)

@@ -513,6 +513,15 @@ def construct_t5_model(cfg: T5Config, hp: HybridParallelConfig, devices=None):
         )
     mesh = build_mesh(hp, devices)
     if hp.pp > 1:
+        if hp.pipeline_type != "pipedream_flush":
+            # t5 has no gpipe scan path, and the 1F1B engine's microbatch
+            # divisibility validation (config/strategy.py) only fires for
+            # pipedream_flush — running it under a gpipe-labelled config
+            # would skip the deadlock-preventing check
+            raise ValueError(
+                "t5 pipeline parallelism runs the enc-dec 1F1B engine: set "
+                "pipeline_type='pipedream_flush' (got %r)" % (hp.pipeline_type,)
+            )
         from galvatron_tpu.parallel.pipeline_1f1b_encdec import (
             make_encdec_loss_and_grad,
             stack_t5_layer_specs,

@@ -55,27 +55,50 @@ def inverse_permutation(idx: np.ndarray) -> np.ndarray:
     return inv
 
 
-def _ring_body(q, k, v, q_pos, k_pos, *, cp_axes: Tuple[str, ...], cp_size: int,
-               causal: bool, sm_scale: float):
+def _ring_body(q, k, v, q_pos, k_pos, bias, *, cp_axes: Tuple[str, ...],
+               cp_size: int, causal: bool, sm_scale: float,
+               key_chunk: int = 512):
     """Per-shard ring attention. q: (b, sq, nh, hd); k/v: (b, sk, nh, hd);
-    q_pos/k_pos: (b, sq)/(b, sk) global positions."""
+    q_pos/k_pos: (b, sq)/(b, sk) global positions; bias: optional additive
+    (b, 1, 1, sk) local key-bias slice that rotates with k.
+
+    Each ring step folds its K/V block in BLOCKWISE: a `lax.scan` over
+    `key_chunk`-sized key chunks carries the online-softmax state
+    (acc, row_max, row_sum), so the peak live buffer is (b, nh, sq,
+    key_chunk) fp32 — O(sq * key_chunk) — never the full (sq, sk) logits the
+    round-2 implementation materialised (O(S^2/cp), which defeated CP at
+    exactly the lengths CP exists for; the reference runs flash inside each
+    ring step for the same reason, transformer.py:2335-2422)."""
     b, sq, nh, hd = q.shape
-    acc = jnp.zeros((b, nh, sq, hd), jnp.float32)
-    row_max = jnp.full((b, nh, sq), -jnp.inf, jnp.float32)
-    row_sum = jnp.zeros((b, nh, sq), jnp.float32)
+    sk = k.shape[1]
+    C = min(key_chunk, sk)
+    while sk % C:
+        C //= 2
+    nc = sk // C
+    # derive the online-softmax state from q so it carries q's varying-manual-
+    # axes type — a plain jnp.zeros carry would fail lax.scan's vma check
+    # inside the shard_map
+    zero_q = q.transpose(0, 2, 1, 3).astype(jnp.float32) * 0.0  # (b, nh, sq, hd)
+    acc = zero_q
+    row_max = zero_q[..., 0] - jnp.inf
+    row_sum = zero_q[..., 0]
     n = cp_size
     perm = [(j, (j + 1) % n) for j in range(n)]
+    has_bias = bias is not None
 
-    k_cur, v_cur, kpos_cur = k, v, k_pos
-    for step in range(n):
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_cur, preferred_element_type=jnp.float32)
+    def chunk_step(carry, inp):
+        acc, row_max, row_sum = carry
+        k_c, v_c, kp_c, b_c = inp
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_c, preferred_element_type=jnp.float32)
         logits = logits * sm_scale
+        if has_bias:
+            logits = logits + b_c.astype(jnp.float32)
         if causal:
-            mask = q_pos[:, None, :, None] >= kpos_cur[:, None, None, :]
+            mask = q_pos[:, None, :, None] >= kp_c[:, None, None, :]
             logits = jnp.where(mask, logits, NEG_INF)
         blk_max = jnp.max(logits, axis=-1)
         new_max = jnp.maximum(row_max, blk_max)
-        # guard -inf rows (fully masked block)
+        # guard -inf rows (fully masked chunk)
         safe_max = jnp.where(jnp.isfinite(new_max), new_max, 0.0)
         corr = jnp.exp(jnp.where(jnp.isfinite(row_max), row_max - safe_max, -jnp.inf))
         corr = jnp.where(jnp.isfinite(row_max), corr, 0.0)
@@ -84,14 +107,29 @@ def _ring_body(q, k, v, q_pos, k_pos, *, cp_axes: Tuple[str, ...], cp_size: int,
             probs = jnp.where(mask, probs, 0.0)
         row_sum = row_sum * corr + jnp.sum(probs, axis=-1)
         acc = acc * corr[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", probs.astype(v_cur.dtype), v_cur,
+            "bhqk,bkhd->bhqd", probs.astype(v_c.dtype), v_c,
             preferred_element_type=jnp.float32,
         )
-        row_max = new_max
+        return (acc, new_max, row_sum), None
+
+    k_cur, v_cur, kpos_cur, bias_cur = k, v, k_pos, bias
+    for step in range(n):
+        xs = (
+            k_cur.reshape(b, nc, C, nh, hd).transpose(1, 0, 2, 3, 4),
+            v_cur.reshape(b, nc, C, nh, hd).transpose(1, 0, 2, 3, 4),
+            kpos_cur.reshape(b, nc, C).transpose(1, 0, 2),
+            (bias_cur.reshape(b, 1, 1, nc, C).transpose(3, 0, 1, 2, 4)
+             if has_bias else jnp.zeros((nc, 1), jnp.float32)),
+        )
+        (acc, row_max, row_sum), _ = jax.lax.scan(
+            chunk_step, (acc, row_max, row_sum), xs
+        )
         if step < n - 1:
             k_cur = jax.lax.ppermute(k_cur, cp_axes, perm)
             v_cur = jax.lax.ppermute(v_cur, cp_axes, perm)
             kpos_cur = jax.lax.ppermute(kpos_cur, cp_axes, perm)
+            if has_bias:
+                bias_cur = jax.lax.ppermute(bias_cur, cp_axes, perm)
     out = acc / jnp.maximum(row_sum, 1e-37)[..., None]
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
@@ -106,9 +144,14 @@ def ring_attention(
     axes: LayerAxes,
     causal: bool = True,
     sm_scale: Optional[float] = None,
+    bias: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Ring attention over `axes.cp`. Inputs are GLOBAL arrays:
-    q/k/v (B, S, nh, hd) sharded (dp, cp, tp, -), positions (B, S) (dp, cp)."""
+    q/k/v (B, S, nh, hd) sharded (dp, cp, tp, -), positions (B, S) (dp, cp);
+    bias: optional additive (B, 1, 1, S) key bias (padding masks) whose key
+    dim shards over cp and rotates with K/V around the ring — the reference's
+    ring path is causal-only and rejects masks; this one supports padded
+    (bert-style) batches under CP."""
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     if k.shape[2] != q.shape[2]:
@@ -121,14 +164,30 @@ def ring_attention(
     bd, cp, tp = _ax(axes.batch_axes), _ax(axes.cp), _ax(axes.tp)
     qkv_spec = P(bd, cp, tp, None)
     pos_spec = P(bd, cp)
+    bias_spec = P(bd, None, None, cp)
     cp_size = mesh_axis_size(mesh, axes.cp)
-    body = lambda q_, k_, v_, qp_, kp_: _ring_body(
-        q_, k_, v_, qp_, kp_, cp_axes=tuple(axes.cp), cp_size=cp_size,
-        causal=causal, sm_scale=sm_scale,
+    body = lambda q_, k_, v_, qp_, kp_, b_: _ring_body(
+        q_, k_, v_, qp_, kp_, b_ if bias is not None else None,
+        cp_axes=tuple(axes.cp), cp_size=cp_size, causal=causal, sm_scale=sm_scale,
     )
+    if bias is None:
+        # a full-shape zero operand satisfies bias_spec's cp sharding (the
+        # body ignores it when bias is None, so XLA dead-code-eliminates it)
+        bias_in = jnp.zeros((q.shape[0], 1, 1, q.shape[1]), jnp.float32)
+    else:
+        bias_in = jnp.broadcast_to(
+            bias.astype(jnp.float32), (q.shape[0], 1, 1, q.shape[1])
+        )
+    # When called inside another manual region (the 1F1B schedule is manual
+    # over 'pp'), shard_map must receive the CONTEXT abstract mesh (whose
+    # already-manual axes are typed Manual) and only make the within-stage
+    # axes manual here.
+    ctx = jax.sharding.get_abstract_mesh()
+    use_mesh = ctx if (ctx is not None and not ctx.empty) else mesh
     return jax.shard_map(
         body,
-        mesh=mesh,
-        in_specs=(qkv_spec, qkv_spec, qkv_spec, pos_spec, pos_spec),
+        mesh=use_mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, pos_spec, pos_spec, bias_spec),
         out_specs=qkv_spec,
-    )(q, k, v, positions, positions)
+        axis_names=set(axes.dp) | set(axes.cp) | set(axes.tp),
+    )(q, k, v, positions, positions, bias_in)

@@ -68,7 +68,12 @@ def validate_pipeline_config(hp: HybridParallelConfig):
             )
     for s in hp.layers:
         if s.cp > 1:
-            raise ValueError("cp>1 with pp>1 is not yet supported in the scan pipeline")
+            raise ValueError(
+                "cp>1 with pp>1 runs through the 1F1B engine "
+                "(pipeline_type='pipedream_flush'), not the scan pipeline: "
+                "the vmapped body here computes attention without the ring "
+                "shard_map, which is wrong for zigzag-permuted cp layouts"
+            )
     if hp.global_bsz % hp.chunks != 0:
         raise ValueError("global_bsz must divide into chunks")
 

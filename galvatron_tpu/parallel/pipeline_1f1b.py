@@ -75,9 +75,17 @@ def validate_1f1b_config(hp: HybridParallelConfig):
         raise ValueError(
             "1f1b pipeline requires equal layers per stage, got pp_division=%s" % (div,)
         )
-    for s in hp.layers:
-        if s.cp > 1:
-            raise ValueError("cp>1 with pp>1 is not yet supported in the 1f1b pipeline")
+    if any(s.cp > 1 for s in hp.layers):
+        lps = div[0]
+        sigs = {tuple(hp.layers[s * lps + j] for j in range(lps)) for s in range(hp.pp)}
+        if len(sigs) != 1:
+            raise ValueError(
+                "ring-attention cp>1 inside the 1F1B schedule requires stage-"
+                "uniform strategies: the ring's collective-permutes must be "
+                "executed identically by every stage every tick (see the "
+                "divergence-safety invariant), which only the single-body "
+                "schedule guarantees"
+            )
     if hp.global_bsz % hp.chunks != 0:
         raise ValueError("global_bsz must divide into chunks")
 
@@ -274,8 +282,12 @@ def make_loss_and_grad(cfg, hp: HybridParallelConfig, mesh: Mesh):
     # anyway (fwd and bwd slots share parity per stage), so wall-clock is
     # unchanged; arithmetic doubles, which only matters for energy. On TPU
     # collectives are matched statically per replica group, so the efficient
-    # lax.cond path (skip invalid slots) is safe and used.
-    mask_not_branch = jax.default_backend() == "cpu"
+    # lax.cond path (skip invalid slots) is safe and used — EXCEPT when ring
+    # CP runs inside the schedule: the ring's collective-permutes need every
+    # participant every tick on any backend, so cp>1 forces the masked path
+    # (validate_1f1b_config already required stage-uniform strategies).
+    has_cp = any(s.cp > 1 for s in hp.layers)
+    mask_not_branch = jax.default_backend() == "cpu" or has_cp
 
     # ------------------------------------------------------- vocab fwd pieces
     def embed_fwd(vparams, inputs, positions, token_types):

@@ -15,6 +15,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from galvatron_tpu.search.cost_model import comm_coe
+
 _CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "csrc")
 _LIB_PATH = os.path.join(_CSRC, "libdp_core.so")
 _lib = None
@@ -260,40 +262,99 @@ class DpOnModel:
         self.use_cpp_core = use_cpp_core
         self.use_pipeline_costmodel = use_pipeline_costmodel
         self.sequence_len = list(sequence_len)
+        self.sequence_parallel = bool(
+            getattr(self.parallel_args_list[0], "sequence_parallel", True)
+            if self.parallel_args_list else True
+        )
         self.logger = logger
 
     # ------------------------------------------------------------ cost pieces
-    def _inter_layer_cost(self, strategies, layer_type: int, bsz: float) -> np.ndarray:
-        """Transition cost between consecutive layers' strategies: the
-        activation resharding volume x allreduce coefficient (reference
-        dynamic_programming.py:290-372). On TPU this is the
-        with_sharding_constraint boundary collective."""
+    @staticmethod
+    def _match_except(si, sj, keys) -> bool:
+        """True when the two strategies differ at most in `keys` of the info
+        dict (reference DpOnModel.match_strategy)."""
+        if si[:3] != sj[:3]:
+            return False
+        a = dict(si[3]) if len(si) > 3 else {}
+        b = dict(sj[3]) if len(sj) > 3 else {}
+        for k in keys:
+            a.pop(k, None)
+            b.pop(k, None)
+        return a == b
+
+    def _inter_layer_cost(self, strategies, layer_type: int, mbsz: float,
+                          min_tp: int = 1) -> np.ndarray:
+        """Per-(prev, cur) transition cost: the activation RESHARDING volume
+        between two layers' shardings times the measured allreduce
+        coefficient for the group the collective rides (re-derivation of the
+        reference's worked case table, dynamic_programming.py:290-372; on TPU
+        the collective is the with_sharding_constraint boundary op).
+
+        A boundary collective is needed when the current layer must re-gather
+        activations the previous layer left sharded differently:
+          - the tp degree grows (hidden shards widen: all-gather),
+          - equal tp but different tp_consecutive (shards move between
+            minor/major mesh axes),
+          - megatron-sp activations with ANY tp change (seq shards re-split),
+          - the cp degree changes (seq shards re-split over the cp axes).
+        Volume: each device then touches its (1/min_tp-normalised) microbatch
+        share of seq x hidden at (max of the two degrees)-way sharding:
+        (d-1)/d x mbsz x (d / min_tp) x seq x hidden x bytes."""
         S = len(strategies)
         ma = self.model_args_list[layer_type]
         ta = self.train_args_list[layer_type]
-        act_mb_full = bsz * ma.seq_length * ma.hidden_size * (2 if ta.mixed_precision else 4) / 1024 / 1024
+        bytes_per = 2 if ta.mixed_precision else 4
+        sample_mb = ma.seq_length * ma.hidden_size * bytes_per / 1024 / 1024
         cost = np.zeros((S, S))
+
+        def info(s):
+            return s[3] if len(s) > 3 else {}
+
         for i, si in enumerate(strategies):  # previous layer
             for j, sj in enumerate(strategies):  # current layer
-                if si[:3] == sj[:3] and (si[3] if len(si) > 3 else {}) == (sj[3] if len(sj) > 3 else {}):
+                ii, ij = info(si), info(sj)
+                tp_i, tp_j = si[1], sj[1]
+                grow_tp = tp_j > tp_i
+                consec_flip = (
+                    tp_j == tp_i and ii.get("tp", 1) != ij.get("tp", 1)
+                )
+                sp_retile = bool(self.sequence_parallel) and tp_j != tp_i
+                cp_change = ii.get("cp", 1) != ij.get("cp", 1)
+                if not (grow_tp or consec_flip or sp_retile or cp_change):
                     continue
-                di, dj = si[2], sj[2]
-                seq_i = si[3].get("cp", 1) * (si[1] if si[3].get("sp", 0) else 1) if len(si) > 3 else 1
-                seq_j = sj[3].get("cp", 1) * (sj[1] if sj[3].get("sp", 0) else 1) if len(sj) > 3 else 1
-                # each device holds act/(dp*seq_shard); resharding moves the
-                # difference; approximate with an all-gather-equivalent volume
-                frac_i = 1.0 / (di * seq_i)
-                frac_j = 1.0 / (dj * seq_j)
-                moved = abs(frac_j - frac_i) * act_mb_full
-                if moved == 0.0 and (si[1] != sj[1]):
-                    # pure tp-degree change still permutes hidden shards
-                    moved = act_mb_full * (1.0 / di) * 0.5
-                cost[i, j] = moved * self._reshard_coe
-        # tiny tie-break bias keeps deterministic ordering of equivalent
-        # sp/fsdp/ckpt variants (reference dynamic_programming.py:355-366)
-        for j, sj in enumerate(strategies):
-            info = sj[3] if len(sj) > 3 else {}
-            cost[:, j] += 1e-7 * (info.get("fsdp", 0) + info.get("sp", 0) * 2 + info.get("cpt", 0) * 4)
+                d = max(tp_i, tp_j, ii.get("cp", 1), ij.get("cp", 1))
+                vol = (d - 1) / d * mbsz * (d // max(min_tp, 1)) * sample_mb
+                # coefficient for the group the collective rides: the larger
+                # tp side's consecutivity decides minor vs major axes
+                big = sj if tp_j >= tp_i else si
+                consec = bool(info(big).get("tp", 1))
+                coe_deg = max(d, 2)
+                try:
+                    coe = comm_coe(self.comm_coe_dict, coe_deg, consec=consec)
+                except KeyError:
+                    coe = self._reshard_coe
+                cost[i, j] = vol * coe
+        # ordered tie-break biases so equivalent variants sort
+        # deterministically: prefer entering sp, then fsdp, then ckpt
+        # (reference dynamic_programming.py:347-371)
+        for i, si in enumerate(strategies):
+            for j, sj in enumerate(strategies):
+                if i == j:
+                    continue
+                ij = info(sj)
+                if self._match_except(si, sj, ["sp"]) and ij.get("sp", 0):
+                    cost[i, j] = 1e-10
+                if self._match_except(si, sj, ["fsdp"]) and ij.get("fsdp", 0):
+                    cost[i, j] = 1e-9
+                if self._match_except(si, sj, ["cpt"]) and ij.get("cpt", 0):
+                    cost[i, j] = 2e-9
+                if (
+                    self._match_except(si, sj, ["fsdp", "cpt"])
+                    and not self._match_except(si, sj, ["fsdp"])
+                    and not self._match_except(si, sj, ["cpt"])
+                    and ij.get("fsdp", 0) and ij.get("cpt", 0)
+                ):
+                    cost[i, j] = 3e-9
         return cost
 
     def _build_stage_dp(self, pp_deg: int, bsz: float, mbsz: float, min_tp: int, max_tp: int,
@@ -361,7 +422,8 @@ class DpOnModel:
             return np.inf, None, -1, -1
         # inter-layer transition matrix depends only on (layer_type, bsz)
         inter_by_type = [
-            self._inter_layer_cost(strategies, t, bsz) for t in range(len(self.layer_nums))
+            self._inter_layer_cost(strategies, t, mbsz, min_tp)
+            for t in range(len(self.layer_nums))
         ]
         start = 0
         for stage in range(pp_deg):

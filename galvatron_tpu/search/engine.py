@@ -317,11 +317,29 @@ class GalvatronSearchEngine:
             weights += [m] * lc["layer_num"]
         out = {}
         for pp in sorted({s[0] for s in self.strategies}):
-            out[pp] = pp_division_memory_balanced(weights, pp)
+            div = pp_division_memory_balanced(weights, pp)
+            # the runtime's stacked-stage engines require EQUAL layers per
+            # stage (pipeline_1f1b.validate_1f1b_config): snap divisible
+            # layer counts to the uniform division so every emitted config
+            # trains (the memory-balanced split re-enters when uneven-stage
+            # support lands); non-divisible layer counts cannot run at this
+            # pp at all, so that degree leaves the search space
+            n = len(weights)
+            if n % pp == 0:
+                out[pp] = [n // pp] * pp
+            # else: this pp degree cannot satisfy the equal-stage contract and
+            # leaves the search space (ok() filters its strategies too)
         return out
 
     def search_for_bsz_chunk(self, bsz: int, chunks: int, min_tp: int = 1,
-                             vsp: int = 0, embed_sdp: bool = False):
+                             max_tp: Optional[int] = None, vsp: int = 0,
+                             embed_sdp: bool = False, sp_search: int = 3):
+        """One DP task of the outer sweep. min_tp/max_tp bound the per-layer
+        tp degrees considered (and min_tp floors the vocab-tp candidates);
+        sp_search selects the sequence-parallel sub-space: 1 = tp-style only
+        (sp flag 0), 2 = ulysses only (sp flag 1), 3 = both (reference outer
+        loop, search_engine.py:339-537)."""
+        max_tp = max_tp or self.args.max_tp_deg
         bundles = self._bundles(chunks)
         ma_list, ta_list, pa_list, pma_list, pha_list = bundles
         # a strategy is only feasible at this bsz if every dp rank gets a
@@ -330,10 +348,30 @@ class GalvatronSearchEngine:
         # 1F1B engine additionally requires the MICROBATCH (bsz/chunks) to
         # shard evenly over the layer's dp degree (uneven shards would pad
         # with collective-permutes inside stage-divergent branches)
+        n_layers = sum(lc["layer_num"] for lc in self.layer_configs)
+        type_bounds = list(np.cumsum([lc["layer_num"] for lc in self.layer_configs])[:-1])
+
         def ok(s):
             if s[2] > bsz or bsz % s[2] != 0:
                 return False
             if s[0] > 1 and (bsz // chunks) % s[2] != 0:
+                return False
+            if s[0] > 1:
+                # runtime contract: equal layers per stage, and (multi-type
+                # models) every layer-type boundary on a stage boundary
+                # (pipeline_1f1b.validate_1f1b_config /
+                # pipeline_1f1b_encdec.validate_encdec_config)
+                if n_layers % s[0] != 0:
+                    return False
+                lps = n_layers // s[0]
+                if any(b % lps != 0 for b in type_bounds):
+                    return False
+            if not (min_tp <= s[1] <= max_tp):
+                return False
+            sp = (s[3] if len(s) > 3 else {}).get("sp", 0)
+            if sp_search == 1 and sp:
+                return False
+            if sp_search == 2 and not sp:
                 return False
             return True
 
@@ -361,10 +399,11 @@ class GalvatronSearchEngine:
             logger=self.logger,
         )
         cost, res, rem, vtp, pp = dpom.fit(
-            bsz, mbsz=max(1, bsz // self.world_size), min_tp=min_tp,
-            max_tp=self.args.max_tp_deg, vsp=vsp, embed_sdp=embed_sdp, chunks=chunks,
+            bsz, mbsz=max(1, bsz * min_tp // self.world_size), min_tp=min_tp,
+            max_tp=max_tp, vsp=vsp, embed_sdp=embed_sdp, chunks=chunks,
         )
         return dict(cost=cost, strategies=res, remaining=rem, vtp=vtp, pp=pp,
+                    min_tp=min_tp, max_tp=max_tp, sp_search=sp_search,
                     bsz=bsz, chunks=chunks, vsp=vsp, embed_sdp=embed_sdp,
                     pp_division=dpom.pp_stage_dict.get(pp))
 
@@ -379,14 +418,38 @@ class GalvatronSearchEngine:
         chunk_opts = [a.settle_chunk] if a.settle_chunk else [1, 2, 4, 8]
         vsp_opts = [a.vsp] if a.vsp in (0, 1) else ([0, 1] if a.sp_space in ("sp", "tp+sp") else [0])
         esdp_opts = [bool(a.embed_sdp)] if a.embed_sdp in (0, 1) else [False, True]
+        # min_tp x max_tp x sp-sub-space sweep (reference search_engine.py:
+        # 348-371): min_tp floors the per-layer AND vocab tp candidates (and
+        # normalises the microbatch the cost models price); sp_search splits
+        # the space into tp-style / ulysses / mixed sub-searches
+        max_strategy_tp = max((s[1] for s in self.strategies), default=1)
+        min_tps = []
+        t = 1
+        while t <= min(a.max_tp_deg, self.world_size, max_strategy_tp):
+            min_tps.append(t)
+            t *= 2
+        if a.disable_vtp:
+            min_tps = [1]
+        # sp_search 1/2 are strict SUBSETS of 3; a per-layer DP's optimum over
+        # the union dominates both, so only the union runs per sp_space
+        # (the reference sweeps the subsets too, mainly for per-task logs)
+        sp_opts = {"tp": [1], "sp": [2], "tp+sp": [3]}.get(a.sp_space, [3])
         tasks = [
-            (bsz, chunks, vsp, embed_sdp)
+            (bsz, chunks, min_tp, vsp, embed_sdp, sp_search)
             for bsz in bszs
             for chunks in chunk_opts
             if bsz % chunks == 0
+            for min_tp in min_tps
             for vsp in vsp_opts
             for embed_sdp in esdp_opts
+            for sp_search in sp_opts
         ]
+
+        def run(t):
+            return self.search_for_bsz_chunk(
+                t[0], t[1], min_tp=t[2], vsp=t[3], embed_sdp=t[4], sp_search=t[5]
+            )
+
         if a.parallel_search and len(tasks) > 1:
             # thread-parallel outer loop (reference --parallel_search,
             # search_engine.py:427-475): each task is an independent DP over
@@ -396,13 +459,9 @@ class GalvatronSearchEngine:
 
             workers = min(len(tasks), max(2, os.cpu_count() or 2))
             with ThreadPoolExecutor(max_workers=workers) as pool:
-                results = list(
-                    pool.map(lambda t: self.search_for_bsz_chunk(t[0], t[1], vsp=t[2], embed_sdp=t[3]), tasks)
-                )
+                results = list(pool.map(run, tasks))
         else:
-            results = [
-                self.search_for_bsz_chunk(b, c, vsp=v, embed_sdp=e) for b, c, v, e in tasks
-            ]
+            results = [run(t) for t in tasks]
         for r in results:
             if r["strategies"] is None or not np.isfinite(r["cost"]):
                 continue

@@ -19,13 +19,11 @@ import pytest  # noqa: E402
 # the virtual 8-device CPU backend (config.update wins over the env var).
 jax.config.update("jax_platforms", "cpu")
 
-# Persistent compilation cache: many tests compile the same reference
-# programs (e.g. the pure-DP trajectory baseline); on a 1-core box compile
-# time dominates suite walltime, and cache hits across tests/processes cut it
-# sharply. The directory is stable across runs so a warm machine is faster
-# still, while a cold run just fills it.
-jax.config.update("jax_compilation_cache_dir", "/tmp/galvatron_tpu_jax_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+# NOTE: the persistent compilation cache was tried here and reverted — XLA:CPU
+# AOT entries embed host machine features, and reloading entries written by a
+# process that detected a different ISA logs "could lead to execution errors
+# such as SIGILL" (cpu_aot_loader.cc). Suite speed comes from small shapes and
+# the extended-tier gating instead.
 
 
 @pytest.fixture(scope="session")

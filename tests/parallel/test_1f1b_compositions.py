@@ -106,6 +106,24 @@ def test_gpt_learned_positions_with_sp(devices8):
     assert losses[-1] < losses[0], losses
 
 
+def test_cp_ring_inside_1f1b(devices8):
+    """Ring-attention context parallelism INSIDE the pipeline (cp=2 x pp=2) —
+    rejected in rounds 1-2 (pipeline.py:69-71 / pipeline_1f1b.py:72-74). The
+    ring's collective-permutes run identically on every stage every tick
+    (stage-uniform strategies + forced masked execution), so the schedule's
+    divergence-safety invariant holds."""
+    stage = [LayerStrategy(cp=2), LayerStrategy(cp=2)]
+    m, batch = _build(stage, devices8, vocab_tp=1, global_bsz=8)
+    compiled, params, opt_state = _compile_step(m, batch)  # guard only
+    tx, _ = get_optimizer_and_scheduler(OptimizerArgs(lr=3e-3, warmup_steps=1, total_steps=20))
+    step = m.make_train_step(tx)
+    losses = []
+    for _ in range(3):
+        params, opt_state, metrics = step(params, opt_state, batch)
+        losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses[-1]) and losses[-1] < losses[0], losses
+
+
 def test_bisect_probe_sp_without_fsdp(devices8):
     """Bisection probe: sp kept, fsdp+ckpt removed — this variant deadlocked
     pre-fix, refuting the 'ZeRO-3 + remat on one layer' diagnosis."""

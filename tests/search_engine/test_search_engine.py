@@ -177,3 +177,88 @@ def test_ulysses_compute_parity_with_tp():
     t_sp = TimeCostModel([1, 4, 2, {"sp": 1}], **common).gen_result()
     # same compute share; only the collective pattern differs -> within 2x
     assert t_sp < 2.0 * t_tp
+
+
+# ------------------------------------------------- inter-layer transition cost
+def _bare_dpom():
+    """A DpOnModel shell with just the state _inter_layer_cost reads."""
+    from galvatron_tpu.search.cost_model_args import ModelArgs, TrainArgs
+    from galvatron_tpu.search.dynamic_programming import DpOnModel
+
+    d = object.__new__(DpOnModel)
+    d.model_args_list = [ModelArgs(seq_length=128, hidden_size=64)]
+    d.train_args_list = [TrainArgs(mixed_precision=False)]
+    d.comm_coe_dict = {"2": 0.01, "4_1": 0.02, "4_0": 0.03}
+    d.sequence_parallel = True
+    d._reshard_coe = 0.01
+    return d
+
+
+def test_inter_layer_cost_cases():
+    """The per-case table (reference dynamic_programming.py:290-372): growing
+    tp costs, shrinking does not (megatron-sp retile aside), tp_consec flips
+    cost, identical strategies are free, and the consecutivity of the larger
+    side picks the coefficient."""
+    d = _bare_dpom()
+    s_tp1 = [1, 1, 8, {}]
+    s_tp2 = [1, 2, 4, {"tp": 1}]
+    s_tp4 = [1, 4, 2, {"tp": 1}]
+    s_tp4n = [1, 4, 2, {"tp": 0}]
+    strats = [s_tp1, s_tp2, s_tp4, s_tp4n]
+    cost = d._inter_layer_cost(strats, 0, mbsz=2, min_tp=1)
+    i1, i2, i4, i4n = 0, 1, 2, 3
+    assert cost[i1, i1] == 0.0
+    assert cost[i1, i2] > 0.0            # tp grows
+    assert cost[i2, i4] > cost[i1, i2]   # wider group moves more
+    assert cost[i4, i4n] > 0.0           # consecutivity flip retiles
+    # the larger-tp side's consecutivity selects minor vs major coefficient
+    assert cost[i1, i4n] > cost[i1, i4]
+    # without megatron-sp, shrinking tp needs no boundary collective
+    d.sequence_parallel = False
+    cost2 = d._inter_layer_cost(strats, 0, mbsz=2, min_tp=1)
+    assert cost2[i4, i2] == 0.0 and cost2[i2, i4] > 0.0
+
+
+def test_inter_layer_tiebreak_ordering():
+    """Equivalent variants order deterministically: entering sp is cheapest,
+    then fsdp, then ckpt, then fsdp+ckpt (reference :347-371)."""
+    d = _bare_dpom()
+    base = [1, 2, 4, {"tp": 1}]
+    sp = [1, 2, 4, {"tp": 1, "sp": 1}]
+    fsdp = [1, 2, 4, {"tp": 1, "fsdp": 1}]
+    cpt = [1, 2, 4, {"tp": 1, "cpt": 1}]
+    both = [1, 2, 4, {"tp": 1, "fsdp": 1, "cpt": 1}]
+    strats = [base, sp, fsdp, cpt, both]
+    cost = d._inter_layer_cost(strats, 0, mbsz=2, min_tp=1)
+    assert cost[0, 1] < cost[0, 2] < cost[0, 3] < cost[0, 4]
+
+
+def test_sp_space_sweep_changes_winner():
+    """The sp-sub-space dimension must be able to change the winner: with an
+    all2all table that makes ulysses communication ~free and an expensive
+    allreduce table, sp_space='tp+sp' finds an sp winner that
+    sp_space='tp' cannot (the round-2 search had no sp-space sweep)."""
+    slow_ar = {k: 2.0 for k in ALLREDUCE_BW}          # ~zero bandwidth
+    cheap_a2a = {"all2all": {"2": {"popt": [1e-6, 0.0]}, "4": {"popt": [1e-6, 0.0]},
+                             "8": {"popt": [1e-6, 0.0]}}}
+
+    def run(sp_space):
+        args = SearchArgs(memory_constraint=16.0, settle_bsz=16, settle_chunk=2,
+                          max_tp_deg=8, sp_space=sp_space, disable_pp=True)
+        eng = GalvatronSearchEngine(
+            args, 8, [{"hidden_size": 4096, "seq_len": 2048, "layer_num": 8}],
+            model_name="mock",
+        )
+        eng.set_model_profiles(TIME_CONFIG, MEMORY_CONFIG)
+        eng.set_hardware_profiles(slow_ar, P2P_BW, {"overlap_coe": 1.12},
+                                  sp_time_config=cheap_a2a)
+        eng.initialize_search_engine()
+        return eng.parallelism_optimization()
+
+    tp_only = run("tp")
+    mixed = run("tp+sp")
+    assert mixed is not None
+    uses_sp = any((s[3] if len(s) > 3 else {}).get("sp") for s in mixed["strategies"])
+    assert uses_sp, mixed["strategies"]
+    if tp_only is not None:
+        assert 16.0 / mixed["cost"] >= 16.0 / tp_only["cost"]

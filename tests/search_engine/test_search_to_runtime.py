@@ -25,19 +25,14 @@ def test_searched_config_trains(tmp_path, devices8):
     path = eng.save_results(best, str(tmp_path / "searched.json"))
 
     hp = HybridParallelConfig.from_json(path, world_size=8)
-    # pipelined path needs uniform stage structure; searched configs may be
-    # heterogeneous — uniformise within stages if needed for this smoke test
+    # NO skips: the search only emits divisions the runtime accepts (equal
+    # layers per stage, engine._pp_stage_dict snapping), pp>1 routes to the
+    # 1F1B engine which takes heterogeneous per-stage strategies — every
+    # searched config must construct and train (round-2 weak item #5)
     cfg = M.TransformerConfig(
         hidden_size=64, num_heads=4, num_layers=4, vocab_size=128, max_seq_len=64,
         compute_dtype=jnp.float32,
     )
-    if hp.pp > 1:
-        from galvatron_tpu.parallel.pipeline import validate_pipeline_config
-
-        try:
-            validate_pipeline_config(hp)
-        except ValueError:
-            pytest.skip("searched config not stage-uniform; covered elsewhere")
     m = construct_hybrid_parallel_model(cfg, hp, devices8)
     params = m.init_params(jax.random.PRNGKey(0))
     tx, _ = get_optimizer_and_scheduler(OptimizerArgs(lr=1e-3, warmup_steps=1, total_steps=5))
