@@ -153,6 +153,14 @@ def build_parser(mode: str, extra_args_provider: Optional[Callable] = None) -> a
     p = argparse.ArgumentParser("galvatron_tpu-%s" % mode, allow_abbrev=False)
     p.add_argument("--config_dir", type=str, default="configs",
                    help="where profiled/searched JSON configs live")
+    g = p.add_argument_group("distributed")
+    g.add_argument("--coordinator_address", type=str, default=None,
+                   help="multi-host bootstrap: host:port of process 0 "
+                        "(TPU pod slices auto-discover; see runtime/distributed.py)")
+    g.add_argument("--num_processes", type=int, default=None,
+                   help="multi-host bootstrap: total process count")
+    g.add_argument("--process_id", type=int, default=None,
+                   help="multi-host bootstrap: this process's rank")
     _add_model_args(p)
     if mode in ("train", "train_dist"):
         _add_parallel_args(p)
@@ -179,6 +187,16 @@ def initialize_galvatron(extra_args_provider: Optional[Callable] = None,
     core/arguments.py:8-30)."""
     args = build_parser(mode, extra_args_provider).parse_args(argv)
     args.galvatron_mode = mode
+    if mode in ("train", "train_dist", "profile_hardware"):
+        # multi-host bootstrap before any jax.devices() call (the reference's
+        # torch.distributed env:// init point, core/arguments.py:8-30)
+        from galvatron_tpu.runtime.distributed import initialize_distributed
+
+        initialize_distributed(
+            getattr(args, "coordinator_address", None),
+            getattr(args, "num_processes", None),
+            getattr(args, "process_id", None),
+        )
     return args
 
 

@@ -482,9 +482,49 @@ def construct_swin_model(cfg: SwinConfig, hp: HybridParallelConfig, devices=None
                 "block %d (stage %d) has %d heads, not divisible by tp=%d"
                 % (i, cfg.stage_of_block(i), nh, ls.tp)
             )
-    if hp.pp > 1:
-        raise NotImplementedError("swin pipeline parallelism lands with the stage pipeline")
     mesh = build_mesh(hp, devices)
+    if hp.pp > 1:
+        if hp.pipeline_type != "pipedream_flush":
+            # swin has no gpipe scan path (stage shapes differ); the 1F1B
+            # engine's microbatch validation only fires for pipedream_flush
+            raise ValueError(
+                "swin pipeline parallelism runs the hierarchical 1F1B engine: "
+                "set pipeline_type='pipedream_flush' (got %r)" % (hp.pipeline_type,)
+            )
+        from galvatron_tpu.parallel.pipeline_1f1b_swin import (
+            make_swin_loss_and_grad,
+            stack_swin_layer_specs,
+            stack_swin_params,
+            validate_swin_config,
+        )
+
+        validate_swin_config(cfg, hp)
+        specs = {
+            k: v for k, v in swin_param_specs(cfg, hp).items() if k != "blocks" and k != "merges"
+        }
+        specs["stages"] = stack_swin_layer_specs(cfg, hp)
+        grad_fn = make_swin_loss_and_grad(cfg, hp, mesh)
+
+        def init_fn(rng):
+            canonical = init_swin_params(rng, cfg)
+            out = {
+                "embed": canonical["embed"],
+                "final_norm": canonical["final_norm"],
+                "head": canonical["head"],
+            }
+            out["stages"] = stack_swin_params(canonical, cfg, hp)
+            return out
+
+        return HybridParallelModel(
+            cfg=cfg,
+            hp=hp,
+            mesh=mesh,
+            param_specs=specs,
+            loss_fn=lambda p, b: grad_fn(p, b)[0],
+            forward_fn=None,
+            init_fn=init_fn,
+            grad_fn=grad_fn,
+        )
     return HybridParallelModel(
         cfg=cfg,
         hp=hp,

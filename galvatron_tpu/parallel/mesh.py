@@ -76,9 +76,11 @@ def build_mesh(
     shape = (config.pp,) + subaxis_sizes(config.per_stage_devices)
     names = (PP_AXIS,) + subaxis_names(config.per_stage_devices)
     try:
-        from jax.experimental import mesh_utils
+        # multi-host: hybrid ICI/DCN placement (pp + major-dp span hosts,
+        # tp/cp stay on intra-host ICI — runtime/distributed.py)
+        from galvatron_tpu.runtime.distributed import device_mesh_for
 
-        dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+        dev_array = device_mesh_for(shape, devices)
     except Exception:
         dev_array = np.array(devices).reshape(shape)
     return Mesh(dev_array, names)

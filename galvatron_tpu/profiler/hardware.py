@@ -99,9 +99,11 @@ class HardwareProfiler:
         else:
             shape, names, group_axis = (group_size, outer), ("inner", "outer"), "inner"
         try:
-            from jax.experimental import mesh_utils
+            # hybrid-aware placement: on multi-host runs the MAJOR axis spans
+            # DCN, so non-consec groups measure cross-host bandwidth
+            from galvatron_tpu.runtime.distributed import device_mesh_for
 
-            dev_array = mesh_utils.create_device_mesh(shape, devices=self.devices)
+            dev_array = device_mesh_for(shape, self.devices)
         except Exception:
             dev_array = np.array(self.devices).reshape(shape)
         return Mesh(dev_array, names), group_axis
@@ -118,12 +120,16 @@ class HardwareProfiler:
         return jax.device_put(x, NamedSharding(mesh, P(tuple(mesh.axis_names))))
 
     # ------------------------------------------------------------- collectives
-    def _collective_time_ms(self, kind: str, group_size: int, consec: bool, mb: float) -> float:
+    def _collective_time_ms(
+        self, kind: str, group_size: int, consec: bool, mb: float,
+        mesh_gax: Optional[Tuple[Mesh, str]] = None,
+    ) -> float:
         """Time one collective over all size-`group_size` groups at once; the
-        per-rank message is `mb` MB."""
+        per-rank message is `mb` MB. `mesh_gax` overrides the mesh/group-axis
+        placement (the DCN profile pins groups to whole hosts)."""
         if group_size > self.ndev:
             raise ValueError("group size %d > %d devices" % (group_size, self.ndev))
-        mesh, gax = self._group_mesh(group_size, consec)
+        mesh, gax = mesh_gax if mesh_gax is not None else self._group_mesh(group_size, consec)
         x = self._message(mesh, mb)
         all_axes = tuple(mesh.axis_names)
 
@@ -228,6 +234,44 @@ class HardwareProfiler:
                 fits[kind][g] = {"popt": [float(max(m, 0.0)), float(max(c, 0.0))]}
         return fits
 
+    def profile_dcn_bandwidth(self) -> Dict[str, float]:
+        """Cross-host (DCN) allreduce bandwidth per host-group size — the
+        TPU-native row for the reference's multi-node path (hostfile + mpirun
+        nccl-tests, hardware_profiler.py:344-370). Groups span g hosts with
+        every local device participating; single-host runs return {} (no
+        DCN to measure)."""
+        from galvatron_tpu.runtime.distributed import dcn_granule_count
+
+        n_proc = dcn_granule_count(self.devices)
+        if n_proc <= 1:
+            return {}
+        per_host = self.ndev // n_proc
+        mb = self.args.end_mb
+
+        def _granule(d):
+            if hasattr(d, "slice_index"):
+                return d.slice_index
+            return getattr(d, "process_index", 0)
+
+        # explicit placement: hosts sorted, group i = hosts [i*g, (i+1)*g) —
+        # each allreduce group spans EXACTLY g whole hosts (a generic
+        # hybrid-mesh factoring would spread every group over all hosts)
+        devs = sorted(self.devices, key=lambda d: (_granule(d), d.id))
+        out: Dict[str, float] = {}
+        g = 2
+        while g <= n_proc:
+            gs = g * per_host
+            arr = np.array(devs).reshape(n_proc // g, gs)
+            mesh = Mesh(arr, ("outer", "inner"))
+            ms = self._collective_time_ms(
+                "allreduce", gs, False, mb, mesh_gax=(mesh, "inner")
+            )
+            out["dcn_allreduce_%dhosts" % g] = round(
+                self.busbw_gbps("allreduce", gs, mb, ms), 3
+            )
+            g *= 2
+        return out
+
     def profile_overlap(self) -> Dict[str, float]:
         """Compute/communication overlap slowdown coefficient (reference
         profile_overlap.py: concurrent compute & allreduce streams ->
@@ -283,6 +327,7 @@ class HardwareProfiler:
             "p2p": os.path.join(d, "p2p_bandwidth_%s.json" % tag),
             "sp": os.path.join(d, "sp_time_%s.json" % tag),
             "overlap": os.path.join(d, "overlap_coefficient.json"),
+            "dcn": os.path.join(d, "dcn_bandwidth_%s.json" % tag),
         }
 
     def profile_all(self, write: bool = True) -> Dict[str, Dict]:
@@ -293,10 +338,12 @@ class HardwareProfiler:
             "p2p": self.profile_p2p_bandwidth(),
             "sp": self.profile_sp_time(),
             "overlap": self.profile_overlap(),
+            "dcn": self.profile_dcn_bandwidth(),
         }
         if write:
             paths = self.config_paths()
             os.makedirs(self.args.config_dir, exist_ok=True)
             for key, data in results.items():
-                write_json_config(data, paths[key])
+                if data:
+                    write_json_config(data, paths[key])
         return results

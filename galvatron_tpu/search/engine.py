@@ -317,7 +317,6 @@ class GalvatronSearchEngine:
             weights += [m] * lc["layer_num"]
         out = {}
         for pp in sorted({s[0] for s in self.strategies}):
-            div = pp_division_memory_balanced(weights, pp)
             # the runtime's stacked-stage engines require EQUAL layers per
             # stage (pipeline_1f1b.validate_1f1b_config): snap divisible
             # layer counts to the uniform division so every emitted config

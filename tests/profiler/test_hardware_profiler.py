@@ -77,6 +77,11 @@ def test_profile_all_writes_files(devices8, tmp_path):
     prof = HardwareProfiler(args, devices=devices8)
     results = prof.profile_all(write=True)
     for key, path in prof.config_paths().items():
+        if key == "dcn":
+            # single-host: no DCN row (written only when granules > 1)
+            assert not os.path.exists(path)
+            continue
         assert os.path.exists(path), key
         assert read_json_config(path)
+    assert results["dcn"] == {}
     assert results["overlap"]["overlap_coe"] >= 1.0
