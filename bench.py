@@ -24,6 +24,7 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
 import json
 import os
 import time
+from functools import partial
 
 import numpy as np
 
@@ -42,7 +43,9 @@ WARMUP, ITERS, ROUNDS = (1, 3, 2) if SMOKE else (3, 10, 5)
 
 # LLaMA-7B layer shapes for the train-step metric
 L7B_HIDDEN, L7B_FFN, L7B_HEADS, L7B_SEQ = (512, 1376, 8, 256) if SMOKE else (4096, 11008, 32, 2048)
-L7B_LAYERS = 2 if SMOKE else 4
+# 2 layers (~405M params): fp32 master+adam states ~4.9GB + grads + activations
+# fits the single (possibly shared) chip; per-token metrics are depth-invariant
+L7B_LAYERS = 2
 L7B_BATCH = 1 if SMOKE else 4
 
 # peak dense bf16 matmul throughput per chip, FLOP/s
@@ -147,7 +150,9 @@ def train_step_metric():
             y = M.layer_forward(lp, y, positions, cfg)
         return jnp.mean(y.astype(jnp.float32) ** 2)
 
-    @jax.jit
+    # donate params + opt state: without donation the updated copies double
+    # the resident model states and OOM the chip
+    @partial(jax.jit, donate_argnums=(0, 1))
     def step(layers, opt_state, x):
         loss, grads = jax.value_and_grad(loss_fn)(layers, x)
         updates, opt_state = tx.update(grads, opt_state, layers)

@@ -39,6 +39,7 @@ def search_args_from(args) -> SearchArgs:
         mixed_precision=args.mixed_precision == "bf16",
         default_dp_type=getattr(args, "default_dp_type", "ddp"),
         parallel_search=bool(args.parallel_search),
+        log_dir=args.log_dir,
     )
 
 

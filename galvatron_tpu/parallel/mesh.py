@@ -75,13 +75,17 @@ def build_mesh(
     devices = list(devices)[: config.world_size]
     shape = (config.pp,) + subaxis_sizes(config.per_stage_devices)
     names = (PP_AXIS,) + subaxis_names(config.per_stage_devices)
-    try:
-        # multi-host: hybrid ICI/DCN placement (pp + major-dp span hosts,
-        # tp/cp stay on intra-host ICI — runtime/distributed.py)
-        from galvatron_tpu.runtime.distributed import device_mesh_for
+    # multi-host: hybrid ICI/DCN placement (pp + major-dp span hosts, tp/cp
+    # stay on intra-host ICI — runtime/distributed.py)
+    from galvatron_tpu.runtime.distributed import dcn_granule_count, device_mesh_for
 
+    try:
         dev_array = device_mesh_for(shape, devices)
     except Exception:
+        if dcn_granule_count(devices) > 1:
+            # never silently downgrade a multi-host run to a locality-blind
+            # reshape: tp/cp would span DCN and cripple every collective
+            raise
         dev_array = np.array(devices).reshape(shape)
     return Mesh(dev_array, names)
 

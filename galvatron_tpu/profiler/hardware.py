@@ -260,6 +260,9 @@ class HardwareProfiler:
         out: Dict[str, float] = {}
         g = 2
         while g <= n_proc:
+            if n_proc % g:
+                g *= 2
+                continue
             gs = g * per_host
             arr = np.array(devs).reshape(n_proc // g, gs)
             mesh = Mesh(arr, ("outer", "inner"))

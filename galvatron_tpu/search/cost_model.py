@@ -27,7 +27,7 @@ from __future__ import annotations
 
 import math
 from dataclasses import dataclass
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -435,8 +435,29 @@ class TimeCostModel:
 
 class OtherTimeCostModel:
     """Embedding/cls stage time per candidate vocab-tp (reference
-    cost_model.py:468-658, compacted): profiled embed+cls forward time plus
-    vocab-parallel collective cost."""
+    OtherTimeCostModel, cost_model.py:468-658, re-derived): per affected
+    stage, compute time overlapped with the vocab-state gradient sync plus
+    the vocab-parallel collective —
+
+        stage_time = overlap(dp_fwd_comm, fct) + overlap(dp_bwd_comm, bct)
+                     + tp_message_time
+
+    - fct/bct: the PROFILED embed+head forward fit (other_time_profiled)
+      and its backward ratio; at pp>1 split evenly between the first stage
+      (embedding) and last stage (head), each with its own sequence length
+      (ref estimate_fct_time :572-590);
+    - tp message: one activation allreduce per direction over vocab-tp,
+      first stage priced at the first sequence length, last at the last
+      (ref estimate_tp_time :532-570); vsp shards instead of replicating,
+      so its collective rides the loss reduction (no extra term);
+    - dp sync: the embed/head parameter states (measured model-states MB /
+      4 = param MB) allreduced over the vocab dp group; under embed_sdp
+      (ZeRO-3) the forward re-gather adds a 0.5 factor and the backward
+      reduce-scatter+gather a 1.0 factor vs plain dp's (0, 0.5) (ref
+      estimate_dp_time :592-625);
+    - overlap: compute is slowed by dp_overlap_coe while the sync is in
+      flight; whichever finishes later bounds the stage (ref
+      get_overlap_time :634-645)."""
 
     def __init__(
         self,
@@ -456,26 +477,67 @@ class OtherTimeCostModel:
         logger=None,
     ):
         ma, ta, pma, pha = model_args, train_args, profile_model_args, profile_hardware_args
+        seqs = list(sequence_length_list)
+        pp_off, pp_on = pma.other_memory_pp_off, pma.other_memory_pp_on
+
+        def get(d, key):
+            return d.get(key, d.get(str(key), 0.0)) or 0.0
+
+        coe_overlap = max(pha.dp_overlap_coe, 1.0)
+
+        def overlap(comm_t: float, comp_t: float) -> float:
+            comp_slow = comp_t * coe_overlap
+            if comp_slow > comm_t:
+                return comm_t + (comp_slow - comm_t) / coe_overlap
+            return comm_t
+
+        fwd_factor, bwd_factor = (0.5, 1.0) if embed_sdp else (0.0, 0.5)
+
         self.cost: Dict[int, List[float]] = {}
         k = min_tp
         while k <= max_tp and (world_size // pp_deg) >= k:
             fct = _eval_fit(pma.other_time_profiled, mbsz / k)
             bct = fct * pha.bct_fct_coe
-            comm = 0.0
-            if k > 1 and not vsp:
-                msg_mb = sum(
-                    mbsz * s * ma.hidden_size * (2 if ta.mixed_precision else 4) / 1024 / 1024
-                    for s in sequence_length_list
-                )
-                comm = 2 * _table_time(pha.allreduce_dict, k, msg_mb) if pha.allreduce_dict else (
-                    2 * (k - 1) / k * msg_mb * comm_coe(pha.comm_coe_dict, k)
-                )
-            total = fct + bct + comm
+
+            def tp_msg(seq_len: float) -> float:
+                if k <= 1 or vsp:
+                    return 0.0
+                msg_mb = mbsz * seq_len * ma.hidden_size * (
+                    2 if ta.mixed_precision else 4
+                ) / 1024 / 1024
+                if pha.allreduce_dict:
+                    return 2 * _table_time(pha.allreduce_dict, k, msg_mb)
+                return 2 * (k - 1) / k * msg_mb * comm_coe(pha.comm_coe_dict, k)
+
+            # vocab dp group + ms/MB coefficient for the grad sync
+            dp_deg = max(world_size // pp_deg // (1 if vsp else k), 1)
+            dcoe = comm_coe(pha.comm_coe_dict, dp_deg) * (
+                (dp_deg - 1) / dp_deg if dp_deg > 1 else 0.0
+            )
+
+            def dp_sync(states_mb: float) -> Tuple[float, float]:
+                param_mb = states_mb / 4.0  # measured 4x states -> param grads
+                return param_mb * dcoe * fwd_factor, param_mb * dcoe * bwd_factor
+
             if pp_deg == 1:
-                self.cost[k] = [total]
+                states = get(pp_off.get("model_states", {}), 1 if vsp else k)
+                cf, cb = dp_sync(states)
+                tp_t = tp_msg(seqs[0]) + (tp_msg(seqs[-1]) if len(seqs) > 1 else tp_msg(seqs[0]))
+                self.cost[k] = [overlap(cf, fct) + overlap(cb, bct) + tp_t]
             else:
-                # embed on first stage, cls on last
-                self.cost[k] = [total * 0.4] + [0.0] * (pp_deg - 2) + [total * 0.6]
+                first = pp_on.get("first_stage", {})
+                last = pp_on.get("last_stage", {})
+                ms_f = get(first.get("model_states", {}), 1 if vsp else k)
+                ms_l = get(last.get("model_states", {}), 1 if vsp else k)
+                cf_f, cb_f = dp_sync(ms_f)
+                cf_l, cb_l = dp_sync(ms_l)
+                stage_f = (
+                    overlap(cf_f, fct / 2) + overlap(cb_f, bct / 2) + tp_msg(seqs[0])
+                )
+                stage_l = (
+                    overlap(cf_l, fct / 2) + overlap(cb_l, bct / 2) + tp_msg(seqs[-1])
+                )
+                self.cost[k] = [stage_f] + [0.0] * (pp_deg - 2) + [stage_l]
             k *= 2
 
     def gen_result(self) -> Dict[int, List[float]]:

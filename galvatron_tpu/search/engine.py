@@ -66,6 +66,36 @@ class SearchArgs:
     mem_cache_gb: float = 0.0
     costmodel_coe: float = 1.0
     parallel_search: bool = False  # thread-parallel outer loop (--parallel_search)
+    log_dir: Optional[str] = None  # per-task search log files (reference
+    # search_engine.py:379-382 get_thread_logger); None = no file logging
+
+
+class _TaskLog:
+    """Append-per-call file log: no logging-registry state to collide across
+    engines with different log_dirs, no file descriptors held open (the
+    outer loop can spawn hundreds of tasks)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        with open(path, "w"):
+            pass
+
+    def info(self, msg: str) -> None:
+        with open(self.path, "a") as f:
+            f.write(msg + "\n")
+
+
+def get_task_logger(log_dir: str, model_name: str, bsz: int, chunks: int,
+                    min_tp: int, max_tp: int, vsp: int, embed_sdp: bool) -> _TaskLog:
+    """Per-task file log under ``log_dir`` (reference get_thread_logger,
+    search_engine/utils.py:9-32: one file per outer-loop task so parallel
+    searches stay separable)."""
+    task_dir = os.path.join(log_dir, "search_bsz%d_chunk%d" % (bsz, chunks))
+    os.makedirs(task_dir, exist_ok=True)
+    return _TaskLog(os.path.join(
+        task_dir,
+        "min_tp%d_max_tp%d_vsp%d_embed_sdp%d.log" % (min_tp, max_tp, vsp, int(embed_sdp)),
+    ))
 
 
 def generate_strategies(world_size: int, args: SearchArgs) -> List[list]:
@@ -339,6 +369,18 @@ class GalvatronSearchEngine:
         (sp flag 0), 2 = ulysses only (sp flag 1), 3 = both (reference outer
         loop, search_engine.py:339-537)."""
         max_tp = max_tp or self.args.max_tp_deg
+        tlog = None
+        if self.args.log_dir:
+            tlog = get_task_logger(
+                self.args.log_dir, self.model_name, bsz, chunks,
+                min_tp, max_tp, vsp, embed_sdp,
+            )
+            tlog.info(
+                "start: bsz=%d chunks=%d min_tp=%d max_tp=%d vsp=%d "
+                "embed_sdp=%d sp_search=%d" % (
+                    bsz, chunks, min_tp, max_tp, vsp, int(embed_sdp), sp_search
+                )
+            )
         bundles = self._bundles(chunks)
         ma_list, ta_list, pa_list, pma_list, pha_list = bundles
         # a strategy is only feasible at this bsz if every dp rank gets a
@@ -376,9 +418,13 @@ class GalvatronSearchEngine:
 
         feasible = [s for s in self.strategies if ok(s)]
         if not feasible:
+            if tlog:
+                tlog.info("no feasible strategies")
             return dict(cost=float("inf"), strategies=None, remaining=0, vtp=1,
                         pp=1, bsz=bsz, chunks=chunks, vsp=vsp, embed_sdp=embed_sdp,
                         pp_division=None)
+        if tlog:
+            tlog.info("%d feasible strategies" % len(feasible))
         dpom = DpOnModel(
             feasible,
             MemoryCostModel,
@@ -401,6 +447,11 @@ class GalvatronSearchEngine:
             bsz, mbsz=max(1, bsz * min_tp // self.world_size), min_tp=min_tp,
             max_tp=max_tp, vsp=vsp, embed_sdp=embed_sdp, chunks=chunks,
         )
+        if tlog:
+            tlog.info("result: cost=%s vtp=%s pp=%s remaining_mem=%s" % (cost, vtp, pp, rem))
+            if res:
+                for i, s in enumerate(res):
+                    tlog.info("layer %d: %s" % (i, form_strategy(s)))
         return dict(cost=cost, strategies=res, remaining=rem, vtp=vtp, pp=pp,
                     min_tp=min_tp, max_tp=max_tp, sp_search=sp_search,
                     bsz=bsz, chunks=chunks, vsp=vsp, embed_sdp=embed_sdp,

@@ -4,7 +4,6 @@ train-few-steps-and-compare pattern, tests/models/test_model_correctness.py:17-5
 re-done without subprocesses on the virtual CPU mesh)."""
 
 import jax
-import jax.numpy as jnp
 import pytest
 
 from galvatron_tpu.config.strategy import HybridParallelConfig, LayerStrategy
@@ -17,26 +16,18 @@ B, S, V = 8, 32, 128
 pytestmark = [pytest.mark.parallel, pytest.mark.distributed]
 
 
-@pytest.fixture(scope="module")
-def cfg():
-    return M.TransformerConfig(
-        hidden_size=64, num_heads=4, num_layers=4, vocab_size=V, max_seq_len=64,
-        compute_dtype=jnp.float32,
-    )
+from tests.conftest import gpt_batch as make_batch
+from tests.conftest import gpt_traj
 
 
 @pytest.fixture(scope="module")
-def params(cfg):
-    return M.init_model_params(jax.random.PRNGKey(0), cfg)
+def cfg(gpt_cfg):
+    return gpt_cfg
 
 
-def make_batch(seed):
-    tokens = jax.random.randint(jax.random.PRNGKey(seed), (B, S), 0, V)
-    return dict(
-        tokens=tokens,
-        positions=jnp.broadcast_to(jnp.arange(S), (B, S)),
-        labels=jnp.roll(tokens, -1, 1),
-    )
+@pytest.fixture(scope="module")
+def params(gpt_params):
+    return gpt_params
 
 
 STRATEGIES = {
@@ -67,22 +58,11 @@ def test_loss_matches_baseline(name, cfg, params, devices8):
     assert abs(loss - baseline) < 2e-5, (name, loss, baseline)
 
 
-def _train_losses(cfg, params, hp, devices, steps=4):
-    m = construct_hybrid_parallel_model(cfg, hp, devices)
-    # copy: the train step donates its params argument; device_put may alias
-    p = jax.device_put(jax.tree.map(jnp.copy, params), m.shardings())
-    tx, _ = get_optimizer_and_scheduler(OptimizerArgs(lr=1e-3, warmup_steps=2, total_steps=10, weight_decay=0.0))
-    opt_state = m.init_opt_state(tx, p)
-    step = m.make_train_step(tx)
-    out = []
-    for i in range(steps):
-        p, opt_state, metrics = step(p, opt_state, m.shard_batch(make_batch(i % 2)))
-        out.append(float(metrics["loss"]))
-    return out
+_train_losses = gpt_traj  # shared trainer (tests/conftest.py), steps=3
 
 
-def test_training_trajectory_strategy_invariant(cfg, params, devices8):
-    ref = _train_losses(cfg, params, HybridParallelConfig.uniform(8, 4, global_bsz=B), devices8)
+def test_training_trajectory_strategy_invariant(cfg, params, gpt_ref_traj, devices8):
+    ref = gpt_ref_traj(1)
     assert ref[-1] < ref[0], "training should reduce loss"
     hetero = HybridParallelConfig(
         world_size=8, pp=1,
@@ -98,9 +78,9 @@ def test_training_trajectory_strategy_invariant(cfg, params, devices8):
     assert max(abs(a - b) for a, b in zip(ref, got)) < 5e-5, (ref, got)
 
 
-def test_grad_accumulation_matches_single_chunk(cfg, params, devices8):
-    one = _train_losses(cfg, params, HybridParallelConfig.uniform(8, 4, global_bsz=B, chunks=1), devices8)
-    two = _train_losses(cfg, params, HybridParallelConfig.uniform(8, 4, global_bsz=B, chunks=2), devices8)
+def test_grad_accumulation_matches_single_chunk(gpt_ref_traj):
+    one = gpt_ref_traj(1)
+    two = gpt_ref_traj(2)
     assert max(abs(a - b) for a, b in zip(one, two)) < 5e-5
 
 

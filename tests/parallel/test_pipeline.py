@@ -6,58 +6,28 @@ import jax.numpy as jnp
 import pytest
 
 from galvatron_tpu.config.strategy import HybridParallelConfig, LayerStrategy
-from galvatron_tpu.models import base as M
 from galvatron_tpu.parallel.pipeline import (
     stack_params,
     unstack_params,
     validate_pipeline_config,
 )
 from galvatron_tpu.runtime.model_api import construct_hybrid_parallel_model
-from galvatron_tpu.runtime.optimizer import OptimizerArgs, get_optimizer_and_scheduler
 
 pytestmark = [pytest.mark.parallel, pytest.mark.distributed]
+
+from tests.conftest import gpt_traj as _traj  # shared baseline machinery
 
 B, S, V = 8, 32, 128
 
 
 @pytest.fixture(scope="module")
-def cfg():
-    return M.TransformerConfig(
-        hidden_size=64, num_heads=4, num_layers=4, vocab_size=V, max_seq_len=64,
-        compute_dtype=jnp.float32,
-    )
+def cfg(gpt_cfg):
+    return gpt_cfg
 
 
 @pytest.fixture(scope="module")
-def params(cfg):
-    return M.init_model_params(jax.random.PRNGKey(0), cfg)
-
-
-def make_batch(seed):
-    tokens = jax.random.randint(jax.random.PRNGKey(seed), (B, S), 0, V)
-    return dict(
-        tokens=tokens,
-        positions=jnp.broadcast_to(jnp.arange(S), (B, S)),
-        labels=jnp.roll(tokens, -1, 1),
-    )
-
-
-def _traj(cfg, params, hp, devices, steps=3):
-    m = construct_hybrid_parallel_model(cfg, hp, devices)
-    p = jax.tree.map(jnp.copy, params)
-    if hp.pp > 1:
-        p["stages"] = stack_params(p.pop("layers"), hp)
-    p = jax.device_put(p, m.shardings())
-    tx, _ = get_optimizer_and_scheduler(
-        OptimizerArgs(lr=1e-3, warmup_steps=2, total_steps=10, weight_decay=0.0)
-    )
-    st = m.init_opt_state(tx, p)
-    step = m.make_train_step(tx)
-    out = []
-    for i in range(steps):
-        p, st, mets = step(p, st, m.shard_batch(make_batch(i % 2)))
-        out.append(float(mets["loss"]))
-    return out
+def params(gpt_params):
+    return gpt_params
 
 
 _EXT = pytest.mark.skipif(
@@ -72,8 +42,8 @@ _EXT = pytest.mark.skipif(
     [(2, 1, 2), (4, 1, 4),
      pytest.param(2, 2, 2, marks=_EXT), pytest.param(2, 1, 1, marks=_EXT)],
 )
-def test_pipeline_matches_dp(cfg, params, devices8, pp, tp, chunks):
-    ref = _traj(cfg, params, HybridParallelConfig.uniform(8, 4, global_bsz=B, chunks=chunks), devices8)
+def test_pipeline_matches_dp(cfg, params, gpt_ref_traj, devices8, pp, tp, chunks):
+    ref = gpt_ref_traj(chunks)
     hp = HybridParallelConfig.uniform(8, 4, pp=pp, tp=tp, global_bsz=B, chunks=chunks)
     got = _traj(cfg, params, hp, devices8)
     assert max(abs(a - b) for a, b in zip(ref, got)) < 5e-5, (ref, got)

@@ -13,54 +13,24 @@ import jax.numpy as jnp
 
 from galvatron_tpu.config.strategy import HybridParallelConfig, LayerStrategy
 from galvatron_tpu.models import base as M
-from galvatron_tpu.parallel.pipeline import stack_params
 from galvatron_tpu.parallel.pipeline_1f1b import build_schedule
 from galvatron_tpu.runtime.model_api import construct_hybrid_parallel_model
-from galvatron_tpu.runtime.optimizer import OptimizerArgs, get_optimizer_and_scheduler
 
 pytestmark = [pytest.mark.parallel, pytest.mark.distributed]
+
+from tests.conftest import gpt_traj as _traj  # shared baseline machinery
 
 B, S, V = 8, 32, 128
 
 
 @pytest.fixture(scope="module")
-def cfg():
-    return M.TransformerConfig(
-        hidden_size=64, num_heads=4, num_layers=4, vocab_size=V, max_seq_len=64,
-        compute_dtype=jnp.float32,
-    )
+def cfg(gpt_cfg):
+    return gpt_cfg
 
 
 @pytest.fixture(scope="module")
-def params(cfg):
-    return M.init_model_params(jax.random.PRNGKey(0), cfg)
-
-
-def make_batch(seed):
-    tokens = jax.random.randint(jax.random.PRNGKey(seed), (B, S), 0, V)
-    return dict(
-        tokens=tokens,
-        positions=jnp.broadcast_to(jnp.arange(S), (B, S)),
-        labels=jnp.roll(tokens, -1, 1),
-    )
-
-
-def _traj(cfg, params, hp, devices, steps=3):
-    m = construct_hybrid_parallel_model(cfg, hp, devices)
-    p = jax.tree.map(jnp.copy, params)
-    if hp.pp > 1:
-        p["stages"] = stack_params(p.pop("layers"), hp)
-    p = jax.device_put(p, m.shardings())
-    tx, _ = get_optimizer_and_scheduler(
-        OptimizerArgs(lr=1e-3, warmup_steps=2, total_steps=10, weight_decay=0.0)
-    )
-    st = m.init_opt_state(tx, p)
-    step = m.make_train_step(tx)
-    out = []
-    for i in range(steps):
-        p, st, mets = step(p, st, m.shard_batch(make_batch(i % 2)))
-        out.append(float(mets["loss"]))
-    return out
+def params(gpt_params):
+    return gpt_params
 
 
 # ---------------------------------------------------------------- schedule
@@ -112,8 +82,8 @@ _EXT = pytest.mark.skipif(
     "pp,tp,chunks",
     [(2, 1, 2), pytest.param(4, 1, 4, marks=_EXT), (2, 2, 4)],
 )
-def test_1f1b_matches_dp(cfg, params, devices8, pp, tp, chunks):
-    ref = _traj(cfg, params, HybridParallelConfig.uniform(8, 4, global_bsz=B, chunks=chunks), devices8)
+def test_1f1b_matches_dp(cfg, params, gpt_ref_traj, devices8, pp, tp, chunks):
+    ref = gpt_ref_traj(chunks)
     hp = HybridParallelConfig.uniform(
         8, 4, pp=pp, tp=tp, global_bsz=B, chunks=chunks, pipeline_type="pipedream_flush"
     )
@@ -125,11 +95,11 @@ def test_1f1b_matches_dp(cfg, params, devices8, pp, tp, chunks):
     assert max(abs(a - b) for a, b in zip(ref, got)) < 2.5e-4, (ref, got)
 
 
-def test_1f1b_heterogeneous_stages(cfg, params, devices8):
+def test_1f1b_heterogeneous_stages(cfg, params, gpt_ref_traj, devices8):
     """Per-stage strategies differ (stage 0: tp=2 + remat, stage 1: dp + ZeRO-3)
     — the configuration class the gpipe scan rejects
     (reference capability anchor: hybrid_parallel_model.py:263-268)."""
-    ref = _traj(cfg, params, HybridParallelConfig.uniform(8, 4, global_bsz=B, chunks=2), devices8)
+    ref = gpt_ref_traj(2)
     hp = HybridParallelConfig(
         world_size=8, pp=2,
         layers=[

@@ -83,6 +83,14 @@ def test_t5_1f1b_matches_single_stage(cfg, devices8):
     assert max(abs(a - b) for a, b in zip(ref, got)) < 2.5e-4, (ref, got)
 
 
+_EXT = pytest.mark.skipif(
+    not __import__("os").environ.get("GALVATRON_EXTENDED_TESTS"),
+    reason="extended matrix (set GALVATRON_EXTENDED_TESTS=1); enc-dec parity "
+    "covers the engine, tp/sp composition is covered by the gpt 1F1B tests",
+)
+
+
+@_EXT
 def test_t5_1f1b_tp2_trains(cfg, devices8):
     """pp=2 x tp=2 (megatron-sp default) + ckpt on the decoder stage: loss
     drops while memorizing one batch."""

@@ -15,7 +15,8 @@ from galvatron_tpu.utils.jsonio import read_json_config
 
 @pytest.fixture(scope="module")
 def profiler(devices8):
-    args = HardwareProfileArgs(start_mb=0.25, end_mb=0.5, warmup=1, iters=2)
+    args = HardwareProfileArgs(start_mb=0.25, end_mb=0.5, warmup=1, iters=2,
+                               overlap_time_multiply=1)
     return HardwareProfiler(args, devices=devices8)
 
 

@@ -121,3 +121,73 @@ def test_comm_coe_placement():
     assert comm_coe(COMM, 4, consec=False) == 0.012
     assert comm_coe(COMM, 8) == 0.01
     assert comm_coe(COMM, 1) == 0.0
+
+
+# ---------------------------------------------------------- other-time model
+def ot(pp_deg, embed_sdp=False, vsp=0, dp_overlap_coe=1.2, min_tp=1, max_tp=4,
+       allreduce_dict=None):
+    from galvatron_tpu.search.cost_model import OtherTimeCostModel
+
+    return OtherTimeCostModel(
+        mbsz=2, pp_deg=pp_deg, world_size=8, vsp=vsp, embed_sdp=embed_sdp,
+        min_tp=min_tp, max_tp=max_tp, sequence_length_list=[2048],
+        model_args=ModelArgs(hidden_size=4096),
+        train_args=TrainArgs(),
+        parallel_args=ParallelArgs(),
+        profile_model_args=ProfileModelArgs(
+            other_time_profiled=2.0,
+            other_memory_pp_off=OTHER_OFF,
+            other_memory_pp_on=OTHER_ON,
+        ),
+        profile_hardware_args=ProfileHardwareArgs(
+            comm_coe_dict=COMM, dp_overlap_coe=dp_overlap_coe,
+            allreduce_dict=allreduce_dict or {},
+        ),
+    ).gen_result()
+
+
+def test_other_time_stage_layout():
+    """pp>1: only the embedding (first) and head (last) stages carry cost
+    (reference gen_result, cost_model.py:648-658)."""
+    res = ot(pp_deg=4)
+    for k, stages in res.items():
+        assert len(stages) == 4
+        assert stages[0] > 0 and stages[-1] > 0
+        assert stages[1] == 0 and stages[2] == 0
+
+
+def test_other_time_embed_sdp_costs_more():
+    """ZeRO-3 on embeddings adds the forward re-gather (fwd factor 0.5 vs 0)
+    and doubles the backward factor (reference estimate_dp_time:621-625)."""
+    plain = ot(pp_deg=2, embed_sdp=False)
+    sdp = ot(pp_deg=2, embed_sdp=True)
+    for k in plain:
+        dp_deg = 8 // 2 // k
+        if dp_deg > 1:
+            assert sum(sdp[k]) > sum(plain[k])
+        else:
+            # no vocab dp group -> nothing to sync either way
+            assert sum(sdp[k]) == sum(plain[k])
+
+
+def test_other_time_vocab_tp_adds_message():
+    """vocab-tp>1 pays the per-direction activation allreduce (priced from
+    the measured table when present); k=1 and vsp pay none (reference
+    estimate_tp_time:532-570)."""
+    free = ot(pp_deg=2, allreduce_dict={"2": {"popt": [0.0, 0.0]}, "4": {"popt": [0.0, 0.0]}})
+    paid = ot(pp_deg=2, allreduce_dict={"2": {"popt": [0.01, 0.1]}, "4": {"popt": [0.01, 0.1]}})
+    assert sum(paid[2]) > sum(free[2])
+    assert sum(paid[1]) == sum(free[1])  # no vocab-tp group at k=1
+    vsp_paid = ot(pp_deg=2, vsp=1, allreduce_dict={"2": {"popt": [0.01, 0.1]}})
+    vsp_free = ot(pp_deg=2, vsp=1, allreduce_dict={"2": {"popt": [0.0, 0.0]}})
+    assert sum(vsp_paid[2]) == sum(vsp_free[2])  # vsp shards: no message
+
+
+def test_other_time_dp_sync_overlaps_compute():
+    """The vocab-state grad sync hides under compute up to dp_overlap_coe:
+    with comm smaller than compute the stage cost approaches pure compute
+    (reference get_overlap_time:634-645)."""
+    fast_net = ot(pp_deg=1, dp_overlap_coe=1.0)
+    slow_net = ot(pp_deg=1, dp_overlap_coe=2.0)
+    for k in fast_net:
+        assert sum(slow_net[k]) >= sum(fast_net[k]) - 1e-9

@@ -262,3 +262,15 @@ def test_sp_space_sweep_changes_winner():
     assert uses_sp, mixed["strategies"]
     if tp_only is not None:
         assert 16.0 / mixed["cost"] >= 16.0 / tp_only["cost"]
+
+
+def test_search_log_dir_writes_task_files(tmp_path):
+    """--log_dir produces one log file per outer-loop task (reference
+    get_thread_logger, search_engine/utils.py:9-32)."""
+    eng = make_engine(log_dir=str(tmp_path))
+    eng.parallelism_optimization()
+    logs = list(tmp_path.rglob("*.log"))
+    assert logs, "no per-task log files written"
+    text = "\n".join(p.read_text() for p in logs)
+    assert "start: bsz=" in text
+    assert "result: cost=" in text or "no feasible strategies" in text
