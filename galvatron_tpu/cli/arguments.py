@@ -77,6 +77,8 @@ def _add_train_args(p: argparse.ArgumentParser):
     g.add_argument("--seed", type=int, default=1234)
     g.add_argument("--data_path", type=str, default=None, help="indexed dataset prefix; default: synthetic data")
     g.add_argument("--profile", type=int, default=0, help="enable the runtime profiler")
+    g.add_argument("--train_log_dir", type=str, default=None,
+                   help="tee rank-0 iteration stats to <dir>/train_<model>.log")
     g.add_argument("--profile_forward", type=int, default=0)
     g.add_argument("--save_profiled_memory", type=int, default=0)
     g.add_argument("--profile_type", type=str, default="computation", choices=("computation", "memory"))

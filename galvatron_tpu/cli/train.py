@@ -118,6 +118,7 @@ def train(args) -> dict:
         warmup=min(2, max(args.train_iters - 1, 0)),
         rank=jax.process_index(),
         model_name="%s_%s" % (args.model_type, args.model_size or fam.default_size),
+        log_dir=getattr(args, "train_log_dir", None),
     )
 
     losses = []

@@ -70,6 +70,9 @@ class RuntimeProfiler:
     rank: int = 0
     save_path: Optional[str] = None
     model_name: str = "model"
+    log_dir: Optional[str] = None  # tee iteration stats to
+    # <log_dir>/train_<model_name>.log (the search engine's per-task log
+    # discipline applied to training; reference logs rank-0 prints only)
     _t0: float = 0.0
     iter_times_ms: List[float] = field(default_factory=list)
     all_times_ms: List[float] = field(default_factory=list)
@@ -130,7 +133,13 @@ class RuntimeProfiler:
             extra = " " + " ".join(
                 "%s=%.4g" % (k, float(v)) for k, v in metrics.items() if np.isscalar(v) or getattr(v, "ndim", 1) == 0
             )
-        print_fn("iter %4d | %8.2f ms%s" % (iteration, self.all_times_ms[-1], extra))
+        line = "iter %4d | %8.2f ms%s" % (iteration, self.all_times_ms[-1], extra)
+        print_fn(line)
+        if self.log_dir:
+            os.makedirs(self.log_dir, exist_ok=True)
+            path = os.path.join(self.log_dir, "train_%s.log" % self.model_name)
+            with open(path, "a") as f:
+                f.write(line + "\n")
 
     # -------------------------------------------------------------------- save
     def save(self, path: Optional[str] = None):

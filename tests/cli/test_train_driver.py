@@ -58,3 +58,13 @@ def test_checkpoint_strategy_assert(devices8, tmp_path):
     run(["--world_size", "8", "--train_iters", "1", "--save", ck])
     with pytest.raises(AssertionError):
         run(["--world_size", "8", "--train_iters", "2", "--load", ck, "--global_tp_deg", "2"])
+
+
+def test_train_log_dir_writes_iteration_stats(devices8, tmp_path):
+    d = str(tmp_path / "tl")
+    run(["--world_size", "8", "--train_log_dir", d, "--log_interval", "1"])
+    import glob
+    files = glob.glob(d + "/train_*.log")
+    assert files, "no train log written"
+    text = open(files[0]).read()
+    assert "iter" in text and "ms" in text
