@@ -79,7 +79,22 @@ def validate_pipeline_config(hp: HybridParallelConfig):
 
 
 def layers_per_stage(hp: HybridParallelConfig) -> int:
-    return hp.pp_division[0]
+    """Slot count of the stacked layout: max layers on any stage. Equal
+    divisions (the gpipe contract) make every slot live on every stage;
+    the 1F1B engine also accepts UNEVEN divisions (reference slices
+    arbitrary model_ranks, pipeline.py:110-112) — stages with fewer layers
+    hold zero-filled padding in the trailing slots, statically skipped by
+    their stage body and receiving exactly-zero gradients."""
+    return max(hp.pp_division)
+
+
+def stage_layer_offsets(hp: HybridParallelConfig) -> List[int]:
+    """Global index of each stage's first layer."""
+    out, acc = [], 0
+    for n in hp.pp_division:
+        out.append(acc)
+        acc += n
+    return out
 
 
 # ------------------------------------------------------- stacked param layout
@@ -91,28 +106,40 @@ def stack_layer_specs(cfg, hp: HybridParallelConfig):
     lps = layers_per_stage(hp)
     out = []
     for j in range(lps):
-        ax = layer_axes(hp, j)  # uniform across stages (validated)
+        # storage-layout hint only: slot j is keyed to GLOBAL layer j's axes
+        # (always valid: max(div) <= total layers); the within-stage layout
+        # is resolved by GSPMD inside the manual-over-pp shard_map, and the
+        # stage bodies reshard per layer
+        ax = layer_axes(hp, j)
         spec_j = layer_param_specs(cfg, ax)
         out.append(jax.tree.map(lambda sp: P(PP_AXIS, *sp), spec_j, is_leaf=lambda x: isinstance(x, P)))
     return out
 
 
 def stack_params(layer_params: List[Params], hp: HybridParallelConfig) -> List[Params]:
-    """[n_layers trees] -> [layers_per_stage trees with leading pp dim]."""
+    """[n_layers trees] -> [layers_per_stage trees with leading pp dim].
+    Uneven divisions pad the short stages' trailing slots with zeros (all
+    layers of a family share one tree shape)."""
     lps = layers_per_stage(hp)
+    offs = stage_layer_offsets(hp)
+    zero = jax.tree.map(jnp.zeros_like, layer_params[0])
     stacked = []
     for j in range(lps):
-        per_stage = [layer_params[s * lps + j] for s in range(hp.pp)]
+        per_stage = [
+            layer_params[offs[s] + j] if j < hp.pp_division[s] else zero
+            for s in range(hp.pp)
+        ]
         stacked.append(jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage))
     return stacked
 
 
 def unstack_params(stacked: List[Params], hp: HybridParallelConfig) -> List[Params]:
-    lps = layers_per_stage(hp)
-    layers: List[Params] = [None] * (lps * hp.pp)  # type: ignore
+    offs = stage_layer_offsets(hp)
+    layers: List[Params] = [None] * len(hp.layers)  # type: ignore
     for j, tree in enumerate(stacked):
         for s in range(hp.pp):
-            layers[s * lps + j] = jax.tree.map(lambda x: x[s], tree)
+            if j < hp.pp_division[s]:
+                layers[offs[s] + j] = jax.tree.map(lambda x: x[s], tree)
     return layers
 
 

@@ -64,27 +64,37 @@ from galvatron_tpu.parallel.mesh import PP_AXIS, layer_axes, vocab_axes
 Params = Dict[str, Any]
 
 
+def _stage_sigs(hp: HybridParallelConfig):
+    """Per-stage (strategy, ...) tuples (variable length under uneven
+    divisions)."""
+    from galvatron_tpu.parallel.pipeline import stage_layer_offsets
+
+    offs = stage_layer_offsets(hp)
+    return [
+        tuple(hp.layers[offs[s] + j] for j in range(hp.pp_division[s]))
+        for s in range(hp.pp)
+    ]
+
+
 def validate_1f1b_config(hp: HybridParallelConfig):
-    """The stacked-parameter layout needs equal layers per stage with the same
-    param-tree *shapes* per within-stage slot; strategies may differ freely
-    across stages (unlike the gpipe scan's uniformity requirement)."""
+    """Strategies may differ freely across stages, and divisions may be
+    UNEVEN (reference slices arbitrary model_ranks, pipeline.py:110-112):
+    short stages' trailing slots hold zero padding their `lax.switch` body
+    statically skips. Ring cp>1 alone requires equal, stage-uniform stages
+    (its collective-permutes must run identically everywhere every tick)."""
     if hp.pp <= 1:
         return
     div = hp.pp_division
-    if len(set(div)) != 1:
-        raise ValueError(
-            "1f1b pipeline requires equal layers per stage, got pp_division=%s" % (div,)
-        )
+    if any(n < 1 for n in div):
+        raise ValueError("every pipeline stage needs >= 1 layer, got %s" % (div,))
     if any(s.cp > 1 for s in hp.layers):
-        lps = div[0]
-        sigs = {tuple(hp.layers[s * lps + j] for j in range(lps)) for s in range(hp.pp)}
-        if len(sigs) != 1:
+        if len(set(_stage_sigs(hp))) != 1:
             raise ValueError(
                 "ring-attention cp>1 inside the 1F1B schedule requires stage-"
-                "uniform strategies: the ring's collective-permutes must be "
-                "executed identically by every stage every tick (see the "
-                "divergence-safety invariant), which only the single-body "
-                "schedule guarantees"
+                "uniform strategies (equal divisions included): the ring's "
+                "collective-permutes must be executed identically by every "
+                "stage every tick (see the divergence-safety invariant), "
+                "which only the single-body schedule guarantees"
             )
     if hp.global_bsz % hp.chunks != 0:
         raise ValueError("global_bsz must divide into chunks")
@@ -220,9 +230,11 @@ def make_loss_and_grad(cfg, hp: HybridParallelConfig, mesh: Mesh):
     tests/parallel/test_pipeline_1f1b.py)."""
     from galvatron_tpu.models import base as M
 
+    from galvatron_tpu.parallel.pipeline import stage_layer_offsets
+
     validate_1f1b_config(hp)
     pp, chunks = hp.pp, hp.chunks
-    lps = hp.pp_division[0]
+    offs = stage_layer_offsets(hp)
     vax = vocab_axes(hp)
     sched = build_schedule(pp, chunks)
 
@@ -241,11 +253,14 @@ def make_loss_and_grad(cfg, hp: HybridParallelConfig, mesh: Mesh):
     # before the branch returns, and (c) the compile-time HLO guard
     # `assert_no_divergent_global_collectives`.
     def stage_body(s: int):
-        lo = s * lps
+        lo = offs[s]
 
         def body(stage_layers: List[Params], x, pos, bias):
             prev = mb_spec
-            for j in range(lps):
+            # statically runs only this stage's live slots; padded trailing
+            # slots (uneven divisions) are never referenced and get
+            # exactly-zero grads from the vjp
+            for j in range(hp.pp_division[s]):
                 gi = lo + j
                 ax = layer_axes(hp, gi)
                 cur = S.act_spec(ax)
@@ -265,10 +280,7 @@ def make_loss_and_grad(cfg, hp: HybridParallelConfig, mesh: Mesh):
     # every stage-uniform searched config), all bodies are identical — skip
     # the lax.switch so the program has NO stage-divergent control flow at
     # all (within-layer heterogeneity lives inside the single body).
-    stage_sigs = {
-        tuple(hp.layers[s * lps + j] for j in range(lps)) for s in range(pp)
-    }
-    uniform_stages = len(stage_sigs) == 1
+    uniform_stages = len(set(_stage_sigs(hp))) == 1
 
     # XLA:CPU's in-process collective runtime keys rendezvous clique-wide: a
     # grouped collective executed by only the stage whose fwd/bwd slot is

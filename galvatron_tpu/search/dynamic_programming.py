@@ -443,6 +443,20 @@ class DpOnModel:
                 for vtp, per_stage in other_mem_all.items()
                 if vtp in otc
             }
+            # uneven division: the stacked layout stores max(partition) slots
+            # on EVERY stage — short stages hold zero-padded params +
+            # optimizer state for the missing slots (pipeline.stack_params).
+            # Charge it conservatively (max over strategies) so a config
+            # that passes the search cannot OOM on its short stages.
+            pad_slots = max(partition) - n_stage
+            if pad_slots > 0:
+                t_pad = layer_type_of[start]
+                pad_mb = pad_slots * max(
+                    int(mem_cost[t_pad][si]["model_states"]) for si in range(S)
+                )
+                other_mem_stage = {
+                    vtp: m + pad_mb for vtp, m in other_mem_stage.items()
+                }
             other_time_stage = {
                 vtp: (otc[vtp][stage] if stage < len(otc[vtp]) else 0.0) * chunks for vtp in other_mem_stage
             }

@@ -347,17 +347,24 @@ class GalvatronSearchEngine:
             weights += [m] * lc["layer_num"]
         out = {}
         for pp in sorted({s[0] for s in self.strategies}):
-            # the runtime's stacked-stage engines require EQUAL layers per
-            # stage (pipeline_1f1b.validate_1f1b_config): snap divisible
-            # layer counts to the uniform division so every emitted config
-            # trains (the memory-balanced split re-enters when uneven-stage
-            # support lands); non-divisible layer counts cannot run at this
-            # pp at all, so that degree leaves the search space
             n = len(weights)
-            if n % pp == 0:
-                out[pp] = [n // pp] * pp
-            # else: this pp degree cannot satisfy the equal-stage contract and
-            # leaves the search space (ok() filters its strategies too)
+            if self.num_layertype == 1:
+                # the generic 1F1B engine accepts UNEVEN divisions (padded
+                # trailing slots). One layer type => uniform weights, so the
+                # memory-balanced split is exactly ceil/floor; ceil stages
+                # first keeps the early stages (largest 1F1B in-flight
+                # activation count) no fatter than max, and minimises the
+                # padded-slot overhead (<= 1 layer per floor stage)
+                if pp <= n:
+                    r = n % pp
+                    out[pp] = [n // pp + 1] * r + [n // pp] * (pp - r)
+            else:
+                # multi-layer-type engines (enc-dec / hierarchical) require
+                # EQUAL stages with type boundaries on stage boundaries:
+                # snap divisible layer counts to the uniform division;
+                # non-divisible counts cannot run at this pp at all
+                if n % pp == 0:
+                    out[pp] = [n // pp] * pp
         return out
 
     def search_for_bsz_chunk(self, bsz: int, chunks: int, min_tp: int = 1,
@@ -398,15 +405,25 @@ class GalvatronSearchEngine:
             if s[0] > 1 and (bsz // chunks) % s[2] != 0:
                 return False
             if s[0] > 1:
-                # runtime contract: equal layers per stage, and (multi-type
-                # models) every layer-type boundary on a stage boundary
-                # (pipeline_1f1b.validate_1f1b_config /
-                # pipeline_1f1b_encdec.validate_encdec_config)
-                if n_layers % s[0] != 0:
-                    return False
-                lps = n_layers // s[0]
-                if any(b % lps != 0 for b in type_bounds):
-                    return False
+                if self.num_layertype == 1:
+                    # generic 1F1B accepts uneven divisions; only pp beyond
+                    # the layer count is impossible
+                    if s[0] > n_layers:
+                        return False
+                    # ring cp>1 requires stage-uniform strategies, which an
+                    # uneven division can never satisfy
+                    # (pipeline_1f1b.validate_1f1b_config)
+                    if n_layers % s[0] != 0 and (s[3] if len(s) > 3 else {}).get("cp", 1) > 1:
+                        return False
+                else:
+                    # multi-type engines: equal layers per stage, and every
+                    # layer-type boundary on a stage boundary
+                    # (pipeline_1f1b_encdec/swin validate_*_config)
+                    if n_layers % s[0] != 0:
+                        return False
+                    lps = n_layers // s[0]
+                    if any(b % lps != 0 for b in type_bounds):
+                        return False
             if not (min_tp <= s[1] <= max_tp):
                 return False
             sp = (s[3] if len(s) > 3 else {}).get("sp", 0)

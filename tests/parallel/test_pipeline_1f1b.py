@@ -186,3 +186,30 @@ def test_1f1b_peak_memory_below_gpipe(devices8):
     gpipe = temp_bytes("gpipe")
     f1b = temp_bytes("pipedream_flush")
     assert f1b < 0.75 * gpipe, (f1b, gpipe)
+
+
+def test_1f1b_uneven_division_matches_dp(cfg, params, gpt_ref_traj, devices8):
+    """Uneven pp_division ([1, 3]) through the 1F1B engine: short stages hold
+    zero-padded trailing slots their switch body statically skips (reference
+    slices arbitrary model_ranks, pipeline.py:110-112). Trajectory parity vs
+    pp=1."""
+    ref = gpt_ref_traj(2)
+    hp = HybridParallelConfig.uniform(
+        8, 4, pp=2, global_bsz=B, chunks=2, pipeline_type="pipedream_flush",
+    )
+    hp.pp_division = [1, 3]
+    got = _traj(cfg, params, hp, devices8)
+    assert max(abs(a - b) for a, b in zip(ref, got)) < 2.5e-4, (ref, got)
+
+
+def test_uneven_stack_unstack_roundtrip(cfg, params):
+    from galvatron_tpu.parallel.pipeline import stack_params, unstack_params
+
+    hp = HybridParallelConfig.uniform(8, 4, pp=2, global_bsz=B, chunks=2,
+                                      pipeline_type="pipedream_flush")
+    hp.pp_division = [1, 3]
+    stacked = stack_params(params["layers"], hp)
+    assert all(a.shape[0] == 2 for a in jax.tree.leaves(stacked))
+    back = unstack_params(stacked, hp)
+    for a, b in zip(jax.tree.leaves(params["layers"]), jax.tree.leaves(back)):
+        assert (a == b).all()

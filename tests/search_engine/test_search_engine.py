@@ -274,3 +274,43 @@ def test_search_log_dir_writes_task_files(tmp_path):
     text = "\n".join(p.read_text() for p in logs)
     assert "start: bsz=" in text
     assert "result: cost=" in text or "no feasible strategies" in text
+
+
+def test_uneven_pp_division_searched_and_trains(devices8):
+    """6 layers with pp=4 in the space: the search emits a memory-balanced
+    UNEVEN division (generic 1F1B accepts it; reference slices arbitrary
+    model_ranks, pipeline.py:110-112) and the emitted config trains."""
+    eng = make_engine(layers=6, bsz=8, chunk=2, search_space="dp+pp",
+                      max_pp_deg=4, disable_vtp=True)
+    div = eng._pp_stage_dict(eng._bundles(2))
+    assert 4 in div and sum(div[4]) == 6 and len(div[4]) == 4
+    best = eng.parallelism_optimization()
+    assert best is not None
+    hp = eng.result_to_config(best)
+    if hp.pp == 4:
+        assert hp.pp_division == div[4]
+    # train one step whatever the winner is
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from galvatron_tpu.models import base as M
+    from galvatron_tpu.runtime.model_api import construct_hybrid_parallel_model
+    from galvatron_tpu.runtime.optimizer import OptimizerArgs, get_optimizer_and_scheduler
+
+    cfg = M.TransformerConfig(hidden_size=64, num_heads=4, num_layers=6,
+                              vocab_size=128, max_seq_len=32,
+                              compute_dtype=jnp.float32)
+    m = construct_hybrid_parallel_model(cfg, hp, devices8)
+    p = m.init_params(jax.random.PRNGKey(0))
+    tx, _ = get_optimizer_and_scheduler(OptimizerArgs(lr=1e-3, warmup_steps=1, total_steps=4))
+    st = m.init_opt_state(tx, p)
+    step = m.make_train_step(tx)
+    tokens = jnp.asarray(np.random.RandomState(0).randint(0, 128, (hp.global_bsz, 32)))
+    batch = m.shard_batch(dict(
+        tokens=tokens,
+        positions=jnp.broadcast_to(jnp.arange(32), (hp.global_bsz, 32)),
+        labels=jnp.roll(tokens, -1, 1),
+    ))
+    p, st, mets = step(p, st, batch)
+    assert np.isfinite(float(mets["loss"]))
