@@ -228,16 +228,19 @@ class ModelProfiler:
         per_layer = (b_hi - b_lo - 2 * extra_params) / (hi - lo)
         return max(per_layer / bsz, 1024.0)
 
-    def _act_bytes_tp(self, t: int, bsz: int, seq: int, k: int) -> Optional[float]:
-        """MEASURED per-device activation bytes per layer per sample at tp=k:
-        compile the layer-stack gradient over a k-device mesh with the
-        runtime's own shardings (weight partitioning plus megatron-sp
-        activation sharding) and difference the compiled per-device peaks.
-        Replaces the act(1)/k derivation — attention under megatron-sp
-        gathers full-sequence tensors whose footprint does NOT divide by k
-        (the reference measures per-tp for the same reason,
-        model_profiler.py:374-559). Returns None when fewer than k local
-        devices exist (single-chip profiling falls back to the derivation)."""
+    def _act_bytes_tp(self, t: int, bsz: int, seq: int, k: int,
+                      kind: str = "tp") -> Optional[float]:
+        """MEASURED per-device activation bytes per layer per sample at
+        degree k of one strategy `kind` — "tp" (megatron-sp), "ulysses", or
+        "cp" (zigzag ring): compile the layer-stack gradient over a k-device
+        mesh with the runtime's own shardings and difference the compiled
+        per-device peaks. Replaces the act(1)/k derivation — attention under
+        megatron-sp gathers full-sequence tensors whose footprint does NOT
+        divide by k, ulysses' all-to-all and the ring's blockwise state have
+        their own footprints (the reference measures per-strategy for the
+        same reason, model_profiler.py:374-559). Returns None when fewer
+        than k local devices exist (single-chip profiling falls back to the
+        derivation)."""
         if k <= 1 or len(jax.devices()) < k:
             return None
         if not isinstance(self.cfg, M.TransformerConfig):
@@ -254,9 +257,11 @@ class ModelProfiler:
         a = self.args
         lo, hi = a.layernum_min, a.layernum_max
 
+        degrees = {"tp": dict(tp=k), "ulysses": dict(tp=k, sp=1), "cp": dict(cp=k)}[kind]
+
         def grad_prog(n):
             cfg = dataclasses.replace(self.cfg, num_layers=max(n, 1))
-            hp = HybridParallelConfig.uniform(k, max(n, 1), tp=k, global_bsz=bsz)
+            hp = HybridParallelConfig.uniform(k, max(n, 1), global_bsz=bsz, **degrees)
             mesh = build_mesh(hp, jax.devices()[:k])
             keys = jax.random.split(jax.random.PRNGKey(0), max(n, 1))
             layers = [M.init_layer_params(kk, cfg) for kk in keys[:n]]
@@ -285,10 +290,15 @@ class ModelProfiler:
             )
             return (lambda ls, xx: jax.grad(fwd)(ls, xx)), (layers, x), shard_bytes
 
-        g_lo, args_lo, p_lo = grad_prog(lo)
-        g_hi, args_hi, p_hi = grad_prog(hi)
-        b_lo = _compiled_peak_bytes(g_lo, args_lo)
-        b_hi = _compiled_peak_bytes(g_hi, args_hi)
+        try:
+            g_lo, args_lo, p_lo = grad_prog(lo)
+            g_hi, args_hi, p_hi = grad_prog(hi)
+            b_lo = _compiled_peak_bytes(g_lo, args_lo)
+            b_hi = _compiled_peak_bytes(g_hi, args_hi)
+        except Exception:
+            # strategy not measurable on this model/mesh (e.g. heads not
+            # divisible by the ulysses degree): fall back to the derivation
+            return None
         per_layer = (b_hi - b_lo - 2 * (p_hi - p_lo)) / (hi - lo)
         return max(per_layer / bsz, 1024.0)
 
@@ -361,6 +371,16 @@ class ModelProfiler:
             for k in tps:
                 measured = self._act_bytes_tp(lt, bsz, seq, k) if k > 1 else None
                 tp_act[k] = round(measured / MB if measured else act1 / k, 3)
+                if k > 1:
+                    # per-strategy rows (ulysses all-to-all / ring blockwise
+                    # footprints differ from act/k); written only when
+                    # measured — the cost model falls back to the derivation
+                    m_u = self._act_bytes_tp(lt, bsz, seq, k, kind="ulysses")
+                    if m_u:
+                        tp_act["ulysses_%d" % k] = round(m_u / MB, 3)
+                    m_c = self._act_bytes_tp(lt, bsz, seq, k, kind="cp")
+                    if m_c:
+                        tp_act["cp_%d" % k] = round(m_c / MB, 3)
             tp_act["checkpoint"] = round(min(act_ckpt, act1), 3)
             out["layertype_%d" % lt] = {
                 "parameter_size": round(param_mb, 3),

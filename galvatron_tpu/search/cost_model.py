@@ -179,6 +179,22 @@ class MemoryCostModel:
                 raise KeyError("no activation profile for tp=%s" % key)
             return float(v)
 
+        def act_live_per_bsz():
+            """Per-device per-sample live activation MB for THIS strategy:
+            prefer the profiler's MEASURED per-strategy rows (ulysses_k /
+            cp_k — multi-chip profiles write them; ulysses' all-to-all and
+            the ring's blockwise state do not follow the act/k division),
+            falling back to the derivation act(tp_key)/seq_shard."""
+            if self.ulysses and self.tp_size > 1:
+                m = act.get("ulysses_%d" % self.tp_size)
+                if m is not None:
+                    return float(m) / self.cp_size
+            elif self.tp_size == 1 and self.cp_size > 1:
+                m = act.get("cp_%d" % self.cp_size)
+                if m is not None:
+                    return float(m)
+            return act_per_bsz(act_tp_key) / seq_shard
+
         mb_bsz = local_bsz / self.chunks
         ckpt_shard = seq_shard * (
             self.tp_size if pa.sequence_parallel and not self.ulysses else 1
@@ -206,7 +222,7 @@ class MemoryCostModel:
             if self.checkpoint:
                 per_mb = act_per_bsz("checkpoint") * mb_bsz / ckpt_shard
             else:
-                per_mb = act_per_bsz(act_tp_key) * mb_bsz / seq_shard
+                per_mb = act_live_per_bsz() * mb_bsz
             self.activation_size = per_mb + overhead
         elif self.checkpoint:
             # per-layer share under remat is just the layer input; the single
@@ -221,7 +237,7 @@ class MemoryCostModel:
             # activation table already reflects megatron-sp sharding; divide
             # by the extra seq sharding (cp, and tp when ulysses).
             held_bsz = local_bsz if self.pp_size > 1 else mb_bsz
-            self.activation_size = act_per_bsz(act_tp_key) * held_bsz / seq_shard
+            self.activation_size = act_live_per_bsz() * held_bsz
 
         # ---- other (embed/cls) memory per candidate vocab-tp ---------------
         self.other_memory_cost: Dict[int, List[float]] = {}

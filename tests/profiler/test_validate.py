@@ -70,3 +70,20 @@ def test_zero3_predicts_less_param_memory_than_ddp(cfg, memory_config, devices8)
     z3 = validate_memory(cfg, HybridParallelConfig.uniform(8, 4, global_bsz=8, sdp=1), memory_config)
     assert z3.predicted_layers_mb < ddp.predicted_layers_mb
     assert z3.measured_mb < ddp.measured_mb
+
+
+def test_measured_strategy_activation_rows(cfg, memory_config, devices8):
+    """The multi-device profile writes MEASURED ulysses_k / cp_k activation
+    rows (reference measures per-strategy, model_profiler.py:374-559), and
+    the memory model consumes them: predictions for ulysses/cp configs stay
+    order-correct."""
+    act = memory_config["layertype_0"]["tp_activation_per_bsz_dict"]
+    assert "ulysses_2" in act, sorted(map(str, act))
+    assert "cp_2" in act, sorted(map(str, act))
+    # measured footprints are positive and within an order of the derivation
+    for key in ("ulysses_2", "cp_2"):
+        assert 0.1 * act[1] / 2 < act[key] < 10 * act[1], (key, act)
+    for kw in (dict(tp=2, sp=1), dict(cp=2)):
+        hp = HybridParallelConfig.uniform(8, cfg.num_layers, global_bsz=8, **kw)
+        v = validate_memory(cfg, hp, memory_config)
+        assert 0.4 < v.ratio < 2.5, (kw, v)
