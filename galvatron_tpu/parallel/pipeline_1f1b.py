@@ -451,12 +451,28 @@ def make_loss_and_grad(cfg, hp: HybridParallelConfig, mesh: Mesh):
                 y_prev, dx_prev, dy, stash, loss, sgrads, vgrads = carry
 
                 # [uniform] embed this tick's injected microbatch — computed
-                # redundantly by every stage (within-stage collectives only)
+                # redundantly by every stage (within-stage collectives only).
+                # Gated on stage 0's forward validity: the predicate is
+                # IDENTICAL on every device (a (T,)-table scalar), so the
+                # cond is not stage-divergent control flow and the O(V)
+                # embedding matmul is skipped on the ~half of ticks whose
+                # injection is dead (warmup/cooldown/odd-parity).
                 inj = xt["inject_mb"]
                 tok = gather_mb(inputs_mb, inj)
                 pos_i = gather_mb(pos_mb, inj)
                 tti_i = gather_mb(tti_mb, inj) if has_tti else None
-                x_inj = embed_fwd(vparams, tok, pos_i, tti_i).astype(act_dtype)
+                # both branches pin their output to mb_spec (invariant (b):
+                # cond branches must return identically-sharded values)
+                x_inj = lax.cond(
+                    xt["fwd_v"][0],
+                    lambda: S.constrain(
+                        embed_fwd(vparams, tok, pos_i, tti_i).astype(act_dtype),
+                        mesh, mb_spec,
+                    ),
+                    lambda: S.constrain(
+                        jnp.zeros((mb, Sq, H), act_dtype), mesh, mb_spec
+                    ),
+                )
 
                 # THE cross-stage collective: every stage's previous-tick
                 # outputs, everywhere. Slices below serve as activation
@@ -544,33 +560,70 @@ def make_loss_and_grad(cfg, hp: HybridParallelConfig, mesh: Mesh):
                 # forward ran the PREVIOUS tick (every stage runs it
                 # redundantly — the last stage is the critical path either
                 # way); its cotangent feeds the last stage's backward NEXT
-                # tick (bwd(j, pp-1) = head(j) + 1 by the slot equations)
+                # tick (bwd(j, pp-1) = head(j) + 1 by the slot equations).
+                # head_v / emb_v are stage-uniform (T,)-table scalars, so
+                # these conds are not stage-divergent; they skip the O(V)
+                # head/embedding matmuls on the ticks whose slot is invalid.
                 e = xt["head_mb"]
-                ev = xt["head_v"].astype(jnp.float32)
                 labels_e = gather_mb(labels_mb, e)
                 mask_e = gather_mb(mask_mb, e) if has_mask else None
                 w_e = weights[jnp.clip(e, 0, chunks - 1)]
-                l_e, head_vjp = jax.vjp(
-                    lambda vp, yy: head_loss(vp, yy, labels_e, mask_e, w_e),
-                    vparams, y_exit,
+
+                def _pin_head(l_e, dvp, dy_h):
+                    # invariant (b): identical branch-output shardings
+                    return (
+                        l_e,
+                        jax.tree.map(
+                            lambda a: S.constrain(a, mesh, S.replicated_spec(a.ndim)), dvp
+                        ),
+                        S.constrain(dy_h, mesh, mb_spec),
+                    )
+
+                def run_head():
+                    l_e, head_vjp = jax.vjp(
+                        lambda vp, yy: head_loss(vp, yy, labels_e, mask_e, w_e),
+                        vparams, y_exit,
+                    )
+                    dvp, dy_h = head_vjp(jnp.ones((), jnp.float32))
+                    return _pin_head(l_e, dvp, dy_h)
+
+                l_e, dvp_head, dy_new = lax.cond(
+                    xt["head_v"],
+                    run_head,
+                    lambda: _pin_head(
+                        jnp.zeros((), jnp.float32),
+                        jax.tree.map(jnp.zeros_like, vparams),
+                        jnp.zeros_like(y_exit),
+                    ),
                 )
-                dvp_head, dy_new = head_vjp(ev)
-                loss = loss + l_e * ev
+                loss = loss + l_e
                 vgrads = jax.tree.map(jnp.add, vgrads, dvp_head)
 
                 # [uniform] embedding backward for the microbatch whose
                 # stage-0 backward ran the PREVIOUS tick (its cotangent
                 # arrived via this tick's all-gather)
                 b0 = xt["emb_mb"]
-                b0v = xt["emb_v"].astype(act_dtype)
                 tok_b = gather_mb(inputs_mb, b0)
                 pos_bb = gather_mb(pos_mb, b0)
                 tti_b = gather_mb(tti_mb, b0) if has_tti else None
-                _, embed_vjp = jax.vjp(
-                    lambda vp: embed_fwd(vp, tok_b, pos_bb, tti_b).astype(act_dtype),
-                    vparams,
+
+                def _pin_tree(t):
+                    return jax.tree.map(
+                        lambda a: S.constrain(a, mesh, S.replicated_spec(a.ndim)), t
+                    )
+
+                def run_emb():
+                    _, embed_vjp = jax.vjp(
+                        lambda vp: embed_fwd(vp, tok_b, pos_bb, tti_b).astype(act_dtype),
+                        vparams,
+                    )
+                    (d,) = embed_vjp(dx0)
+                    return _pin_tree(d)
+
+                dvp_embed = lax.cond(
+                    xt["emb_v"], run_emb,
+                    lambda: _pin_tree(jax.tree.map(jnp.zeros_like, vparams)),
                 )
-                (dvp_embed,) = embed_vjp(dx0 * b0v)
                 vgrads = jax.tree.map(jnp.add, vgrads, dvp_embed)
 
                 return (

@@ -311,6 +311,9 @@ def make_encdec_loss_and_grad(cfg, hp: HybridParallelConfig, mesh):
             # decoder-side tables: stage pe's arrival (dec embedding swap-in)
             # and stage pe's backward, lagged one tick for its embedding bwd
             "arr_pe_mb": jnp.asarray(sched.arr_mb[:, pe] if pe < pp else sched.arr_mb[:, 0]),
+            "arr_pe_v": jnp.asarray(
+                sched.arr_valid[:, pe] if pe < pp else sched.arr_valid[:, 0]
+            ),
             "emb2_mb": jnp.asarray(
                 np.concatenate([[0], sched.bwd_mb[:-1, pe]]) if pe < pp else sched.emb_mb
             ),
@@ -337,9 +340,23 @@ def make_encdec_loss_and_grad(cfg, hp: HybridParallelConfig, mesh):
             def tick(carry, xt):
                 y_prev, dx_prev, dy, stash, loss, sgrads, vgrads = carry
 
-                # [uniform] both embeddings for this tick's injections
-                x_inj_enc = embed_fwd(vparams, gather_mb(enc_mb, xt["inject_mb"])).astype(act_dtype)
-                x_inj_dec = embed_fwd(vparams, gather_mb(dec_mb, xt["arr_pe_mb"])).astype(act_dtype)
+                # [uniform] both embeddings for this tick's injections, gated
+                # on their (stage-uniform) validity scalars so the O(V)
+                # matmuls skip dead ticks; both cond branches pin mb_spec
+                # (invariant (b), pipeline_1f1b.py)
+                def _embed_or_zero(valid, tokens):
+                    return lax.cond(
+                        valid,
+                        lambda: S.constrain(
+                            embed_fwd(vparams, tokens).astype(act_dtype), mesh, mb_spec
+                        ),
+                        lambda: S.constrain(
+                            jnp.zeros((mb, Sq, H), act_dtype), mesh, mb_spec
+                        ),
+                    )
+
+                x_inj_enc = _embed_or_zero(xt["fwd_v"][0], gather_mb(enc_mb, xt["inject_mb"]))
+                x_inj_dec = _embed_or_zero(xt["arr_pe_v"], gather_mb(dec_mb, xt["arr_pe_mb"]))
 
                 # THE cross-stage collective (channel pairs double the width)
                 prev_all = lax.all_gather(jnp.stack([y_prev, dx_prev]), PP_AXIS)
@@ -424,37 +441,57 @@ def make_encdec_loss_and_grad(cfg, hp: HybridParallelConfig, mesh):
                     dps, dx = lax.cond(xt["bwd_v"][stage], run_bwd, zero_bwd, g_in)
                 sgrads = jax.tree.map(jnp.add, sgrads, dps)
 
-                # [uniform] head + loss on the exiting decoder hidden
+                # [uniform] head + loss on the exiting decoder hidden, gated
+                # on head_v (stage-uniform; see pipeline_1f1b.py)
                 e = xt["head_mb"]
-                ev = xt["head_v"].astype(jnp.float32)
                 labels_e = gather_mb(labels_mb, e)
                 mask_e = gather_mb(mask_mb, e) if has_mask else None
                 w_e = weights[jnp.clip(e, 0, chunks - 1)]
-                l_e, head_vjp = jax.vjp(
-                    lambda vp, yy: head_loss(vp, yy, labels_e, mask_e, w_e),
-                    vparams, y_exit,
+
+                def _pin_tree(t):
+                    return jax.tree.map(
+                        lambda a: S.constrain(a, mesh, S.replicated_spec(a.ndim)), t
+                    )
+
+                def run_head():
+                    l_e, head_vjp = jax.vjp(
+                        lambda vp, yy: head_loss(vp, yy, labels_e, mask_e, w_e),
+                        vparams, y_exit,
+                    )
+                    dvp, dy_h = head_vjp(jnp.ones((), jnp.float32))
+                    return l_e, _pin_tree(dvp), S.constrain(dy_h, mesh, mb_spec)
+
+                l_e, dvp_head, dy_h = lax.cond(
+                    xt["head_v"],
+                    run_head,
+                    lambda: (
+                        jnp.zeros((), jnp.float32),
+                        _pin_tree(jax.tree.map(jnp.zeros_like, vparams)),
+                        S.constrain(jnp.zeros_like(y_exit), mesh, mb_spec),
+                    ),
                 )
-                dvp_head, dy_h = head_vjp(ev)
-                loss = loss + l_e * ev
+                loss = loss + l_e
                 vgrads = jax.tree.map(jnp.add, vgrads, dvp_head)
                 dy_new = jnp.stack([dy_h, dy_h * 0.0]).astype(act_dtype)
 
-                # [uniform] encoder embedding backward (stage 0's bwd, lagged)
-                tok_b = gather_mb(enc_mb, xt["emb_mb"])
-                b0v = xt["emb_v"].astype(act_dtype)
-                _, evjp = jax.vjp(
-                    lambda vp: embed_fwd(vp, tok_b).astype(act_dtype), vparams
-                )
-                (dvp_e,) = evjp(dx0 * b0v)
-                vgrads = jax.tree.map(jnp.add, vgrads, dvp_e)
+                # [uniform] encoder / decoder embedding backwards (stage 0's
+                # and stage pe's bwd, lagged), each gated on its validity
+                def _embed_bwd(valid, tokens, cot):
+                    def run():
+                        _, evjp = jax.vjp(
+                            lambda vp: embed_fwd(vp, tokens).astype(act_dtype), vparams
+                        )
+                        (d,) = evjp(cot)
+                        return _pin_tree(d)
 
-                # [uniform] decoder embedding backward (stage pe's bwd, lagged)
-                tok_d = gather_mb(dec_mb, xt["emb2_mb"])
-                d0v = xt["emb2_v"].astype(act_dtype)
-                _, dvjp = jax.vjp(
-                    lambda vp: embed_fwd(vp, tok_d).astype(act_dtype), vparams
-                )
-                (dvp_d,) = dvjp(dx_pe * d0v)
+                    return lax.cond(
+                        valid, run,
+                        lambda: _pin_tree(jax.tree.map(jnp.zeros_like, vparams)),
+                    )
+
+                dvp_e = _embed_bwd(xt["emb_v"], gather_mb(enc_mb, xt["emb_mb"]), dx0)
+                vgrads = jax.tree.map(jnp.add, vgrads, dvp_e)
+                dvp_d = _embed_bwd(xt["emb2_v"], gather_mb(dec_mb, xt["emb2_mb"]), dx_pe)
                 vgrads = jax.tree.map(jnp.add, vgrads, dvp_d)
 
                 return (

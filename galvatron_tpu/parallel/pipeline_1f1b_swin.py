@@ -335,7 +335,17 @@ def make_swin_loss_and_grad(cfg, hp: HybridParallelConfig, mesh):
             def tick(carry, xt):
                 y_prev, dx_prev, dy, stash, loss, sgrads, vgrads = carry
 
-                x_inj = embed_fwd(vparams, gather_mb(pixels_mb, xt["inject_mb"])).astype(act_dtype)
+                # gated on stage 0's forward validity (stage-uniform scalar;
+                # see pipeline_1f1b.py): skip the patch embedding on dead
+                # ticks; both branches pin ch_spec (invariant (b))
+                x_inj = lax.cond(
+                    xt["fwd_v"][0],
+                    lambda: S.constrain(
+                        embed_fwd(vparams, gather_mb(pixels_mb, xt["inject_mb"])).astype(act_dtype),
+                        mesh, ch_spec,
+                    ),
+                    lambda: S.constrain(jnp.zeros((mb, N), act_dtype), mesh, ch_spec),
+                )
 
                 # THE cross-stage collective
                 prev_all = lax.all_gather(jnp.stack([y_prev, dx_prev]), PP_AXIS)
@@ -396,25 +406,50 @@ def make_swin_loss_and_grad(cfg, hp: HybridParallelConfig, mesh):
                     dps, dx = lax.cond(xt["bwd_v"][stage], run_bwd, zero_bwd, g_in)
                 sgrads = jax.tree.map(jnp.add, sgrads, dps)
 
-                # [uniform] head + loss on the exiting activation
+                # [uniform] head + loss on the exiting activation, gated on
+                # head_v (stage-uniform; see pipeline_1f1b.py)
                 e = xt["head_mb"]
-                ev = xt["head_v"].astype(jnp.float32)
                 labels_e = gather_mb(labels_mb, e)
                 w_e = weights[jnp.clip(e, 0, chunks - 1)]
-                l_e, head_vjp = jax.vjp(
-                    lambda vp, yy: head_loss(vp, yy, labels_e, w_e), vparams, y_exit
+
+                def _pin_tree(t):
+                    return jax.tree.map(
+                        lambda a: S.constrain(a, mesh, S.replicated_spec(a.ndim)), t
+                    )
+
+                def run_head():
+                    l_e, head_vjp = jax.vjp(
+                        lambda vp, yy: head_loss(vp, yy, labels_e, w_e), vparams, y_exit
+                    )
+                    dvp, dy_h = head_vjp(jnp.ones((), jnp.float32))
+                    return l_e, _pin_tree(dvp), S.constrain(dy_h, mesh, ch_spec)
+
+                l_e, dvp_head, dy_h = lax.cond(
+                    xt["head_v"],
+                    run_head,
+                    lambda: (
+                        jnp.zeros((), jnp.float32),
+                        _pin_tree(jax.tree.map(jnp.zeros_like, vparams)),
+                        S.constrain(jnp.zeros_like(y_exit), mesh, ch_spec),
+                    ),
                 )
-                dvp_head, dy_h = head_vjp(ev)
-                loss = loss + l_e * ev
+                loss = loss + l_e
                 vgrads = jax.tree.map(jnp.add, vgrads, dvp_head)
 
                 # [uniform] patch-embedding backward (stage 0's bwd, lagged)
                 pix_b = gather_mb(pixels_mb, xt["emb_mb"])
-                b0v = xt["emb_v"].astype(act_dtype)
-                _, evjp = jax.vjp(
-                    lambda vp: embed_fwd(vp, pix_b).astype(act_dtype), vparams
+
+                def run_emb():
+                    _, evjp = jax.vjp(
+                        lambda vp: embed_fwd(vp, pix_b).astype(act_dtype), vparams
+                    )
+                    (d,) = evjp(dx0)
+                    return _pin_tree(d)
+
+                dvp_e = lax.cond(
+                    xt["emb_v"], run_emb,
+                    lambda: _pin_tree(jax.tree.map(jnp.zeros_like, vparams)),
                 )
-                (dvp_e,) = evjp(dx0 * b0v)
                 vgrads = jax.tree.map(jnp.add, vgrads, dvp_e)
 
                 return (

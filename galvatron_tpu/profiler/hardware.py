@@ -349,4 +349,8 @@ class HardwareProfiler:
             for key, data in results.items():
                 if data:
                     write_json_config(data, paths[key])
+                elif os.path.exists(paths[key]):
+                    # an empty profile (e.g. no DCN on this host set) must not
+                    # leave a stale file from a previous topology behind
+                    os.remove(paths[key])
         return results

@@ -29,9 +29,13 @@ def prepare_batch(
     tokens: np.ndarray,
     labels: Optional[np.ndarray] = None,
     loss_mask: Optional[np.ndarray] = None,
+    attn_mask: Optional[np.ndarray] = None,
 ) -> Dict[str, jnp.ndarray]:
     """tokens (B, S) -> model batch dict with positions/labels, zigzag-permuted
-    along the sequence when the strategy uses zigzag context parallelism."""
+    along the sequence when the strategy uses zigzag context parallelism.
+    `attn_mask` (B, S) key-padding masks MUST come through here under zigzag
+    cp: the key bias is sharded over cp and rotated with K/V, so its sequence
+    order has to match the permuted tokens."""
     tokens = np.asarray(tokens)
     B, S = tokens.shape
     if labels is None:
@@ -47,6 +51,8 @@ def prepare_batch(
     }
     if loss_mask is not None:
         batch["loss_mask"] = loss_mask
+    if attn_mask is not None:
+        batch["attn_mask"] = np.asarray(attn_mask)
     if hp is not None and hp.cp_mode == "zigzag" and hp.max_cp > 1:
         idx = zigzag_permutation(S, hp.max_cp)
         batch = {k: v[:, idx] for k, v in batch.items()}

@@ -97,6 +97,17 @@ def hybrid_mesh_shapes(
             % (num_hosts, tuple(shape))
         )
     ici = tuple(s // d for s, d in zip(shape, dcn))
+    # DCN factors must form a contiguous LEADING block: every axis before the
+    # last DCN-carrying axis must be fully DCN. Otherwise a minor (tp/cp)
+    # axis silently absorbs host factors — e.g. shape (3, 4) on 2 hosts would
+    # put tp across DCN — the exact silent-cripple build_mesh refuses.
+    last_dcn = max((i for i, d in enumerate(dcn) if d > 1), default=-1)
+    if any(ici[i] > 1 for i in range(last_dcn)):
+        raise ValueError(
+            "host count %d does not factor into the LEADING axes of mesh "
+            "shape %s (dcn=%s would put a minor axis across DCN)"
+            % (num_hosts, tuple(shape), tuple(dcn))
+        )
     return ici, tuple(dcn)
 
 

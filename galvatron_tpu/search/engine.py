@@ -416,9 +416,11 @@ class GalvatronSearchEngine:
                     if n_layers % s[0] != 0 and (s[3] if len(s) > 3 else {}).get("cp", 1) > 1:
                         return False
                 else:
-                    # multi-type engines: equal layers per stage, and every
-                    # layer-type boundary on a stage boundary
-                    # (pipeline_1f1b_encdec/swin validate_*_config)
+                    # multi-type engines: equal layers per stage, every
+                    # layer-type boundary on a stage boundary, and no ring cp
+                    # (pipeline_1f1b_encdec/swin validate_*_config reject it)
+                    if (s[3] if len(s) > 3 else {}).get("cp", 1) > 1:
+                        return False
                     if n_layers % s[0] != 0:
                         return False
                     lps = n_layers // s[0]
@@ -469,10 +471,24 @@ class GalvatronSearchEngine:
             if res:
                 for i, s in enumerate(res):
                     tlog.info("layer %d: %s" % (i, form_strategy(s)))
-        return dict(cost=cost, strategies=res, remaining=rem, vtp=vtp, pp=pp,
-                    min_tp=min_tp, max_tp=max_tp, sp_search=sp_search,
-                    bsz=bsz, chunks=chunks, vsp=vsp, embed_sdp=embed_sdp,
-                    pp_division=dpom.pp_stage_dict.get(pp))
+        result = dict(cost=cost, strategies=res, remaining=rem, vtp=vtp, pp=pp,
+                      min_tp=min_tp, max_tp=max_tp, sp_search=sp_search,
+                      bsz=bsz, chunks=chunks, vsp=vsp, embed_sdp=embed_sdp,
+                      pp_division=dpom.pp_stage_dict.get(pp))
+        if res is not None and pp > 1 and self.num_layertype == 1:
+            # mirror the runtime validator: the per-layer DP can mix cp>1
+            # and cp=1 layers across stages, which validate_1f1b_config
+            # rejects (ring collectives must run identically on every stage)
+            # — an emitted config must ALWAYS construct
+            from galvatron_tpu.parallel.pipeline_1f1b import validate_1f1b_config
+
+            try:
+                validate_1f1b_config(self.result_to_config(result))
+            except ValueError as e:
+                if tlog:
+                    tlog.info("winner rejected by runtime validator: %s" % e)
+                return dict(result, cost=float("inf"), strategies=None)
+        return result
 
     def parallelism_optimization(self) -> Optional[dict]:
         """Outer loop over bsz x chunks x vsp x embed_sdp (reference

@@ -80,3 +80,30 @@ def test_masked_grad_accum_matches_unchunked(devices8):
 
     one, two = run(1), run(2)
     assert max(abs(a - b) for a, b in zip(one, two)) < 5e-5, (one, two)
+
+
+def test_zigzag_padded_attn_mask_loss_invariant(devices8):
+    """Padded (bert-style) batches under zigzag cp: prepare_batch permutes
+    attn_mask with the tokens, so the cp-sharded key bias indexes the
+    permuted K/V correctly — the loss must match the cp=1 unpermuted run
+    (review finding: the mask previously bypassed the permutation)."""
+    cfg = M.TransformerConfig(
+        hidden_size=64, num_heads=4, num_layers=2, vocab_size=V, max_seq_len=64,
+        compute_dtype=jnp.float32, causal=False,
+    )
+    params = M.init_model_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(0, V, (B, S))
+    mask = np.ones((B, S), np.float32)
+    mask[:, -6:] = 0.0
+    labels = np.roll(tokens, -1, axis=1)
+    out = {}
+    for name, kw in [("cp1", dict()), ("zigzag_cp2", dict(cp=2, cp_mode="zigzag"))]:
+        hp = HybridParallelConfig.uniform(8, 2, global_bsz=B, **kw)
+        m = construct_hybrid_parallel_model(cfg, hp, devices8)
+        p = jax.device_put(params, m.shardings())
+        batch = m.shard_batch(prepare_batch(
+            hp, tokens, labels=labels, loss_mask=mask, attn_mask=mask,
+        ))
+        out[name] = float(jax.jit(m.loss_fn)(p, batch))
+    assert abs(out["cp1"] - out["zigzag_cp2"]) < 2e-5, out
