@@ -243,16 +243,9 @@ class ModelProfiler:
         derivation)."""
         if k <= 1 or len(jax.devices()) < k:
             return None
-        if not isinstance(self.cfg, M.TransformerConfig):
-            # t5/swin build their own layer stacks (subclass _stack_t); their
-            # per-tp measurement falls back to the derivation for now
-            return None
-        from jax.sharding import PartitionSpec as P
 
         from galvatron_tpu.config.strategy import HybridParallelConfig
-        from galvatron_tpu.models.base import layer_param_specs
-        from galvatron_tpu.parallel import spec as S
-        from galvatron_tpu.parallel.mesh import build_mesh, layer_axes
+        from galvatron_tpu.parallel.mesh import build_mesh
 
         a = self.args
         lo, hi = a.layernum_min, a.layernum_max
@@ -260,39 +253,25 @@ class ModelProfiler:
         degrees = {"tp": dict(tp=k), "ulysses": dict(tp=k, sp=1), "cp": dict(cp=k)}[kind]
 
         def grad_prog(n):
-            cfg = dataclasses.replace(self.cfg, num_layers=max(n, 1))
             hp = HybridParallelConfig.uniform(k, max(n, 1), global_bsz=bsz, **degrees)
             mesh = build_mesh(hp, jax.devices()[:k])
-            keys = jax.random.split(jax.random.PRNGKey(0), max(n, 1))
-            layers = [M.init_layer_params(kk, cfg) for kk in keys[:n]]
-            axes = [layer_axes(hp, j) for j in range(n)]
-            layers = [
-                jax.device_put(lp, jax.tree.map(
-                    lambda sp: S.named(mesh, sp), layer_param_specs(cfg, ax),
-                    is_leaf=lambda v: isinstance(v, P),
-                ))
-                for lp, ax in zip(layers, axes)
-            ]
-            x = jax.random.normal(jax.random.PRNGKey(1), (bsz, seq, cfg.hidden_size), self._dtype)
-            positions = jnp.broadcast_to(jnp.arange(seq), (bsz, seq))
-
-            def fwd(layers, x):
-                for j, lp in enumerate(layers):
-                    ax = axes[j]
-                    x = S.constrain(x, mesh, S.act_spec(ax))
-                    x = M.layer_forward(lp, x, positions, cfg, mesh=mesh, axes=ax)
-                return jnp.sum(x.astype(jnp.float32))
-
+            built = self._sharded_stack_t(t, n, bsz, seq, hp, mesh, kind)
+            if built is None:
+                return None
+            fwd, layers, xs = built
             # per-device bytes of the grad outputs, from the actual shardings
             shard_bytes = sum(
                 leaf.nbytes // max(len(leaf.sharding.device_set), 1)
                 for lp in layers for leaf in jax.tree.leaves(lp)
             )
-            return (lambda ls, xx: jax.grad(fwd)(ls, xx)), (layers, x), shard_bytes
+            return (lambda ls, *xx: jax.grad(fwd)(ls, *xx)), (layers,) + tuple(xs), shard_bytes
 
         try:
-            g_lo, args_lo, p_lo = grad_prog(lo)
-            g_hi, args_hi, p_hi = grad_prog(hi)
+            built_lo, built_hi = grad_prog(lo), grad_prog(hi)
+            if built_lo is None or built_hi is None:
+                return None
+            g_lo, args_lo, p_lo = built_lo
+            g_hi, args_hi, p_hi = built_hi
             b_lo = _compiled_peak_bytes(g_lo, args_lo)
             b_hi = _compiled_peak_bytes(g_hi, args_hi)
         except Exception:
@@ -301,6 +280,44 @@ class ModelProfiler:
             return None
         per_layer = (b_hi - b_lo - 2 * (p_hi - p_lo)) / (hi - lo)
         return max(per_layer / bsz, 1024.0)
+
+    def _sharded_stack_t(self, t: int, n: int, bsz: int, seq: int, hp, mesh,
+                         kind: str):
+        """Family hook for the per-strategy measurement: an n-layer stack of
+        layer type `t` with params device_put in the runtime's own shardings
+        under hp's per-layer axes, and a forward applying the same activation
+        constraints. Returns (fwd, layers, xs) or None when this family
+        cannot realise the strategy."""
+        from jax.sharding import PartitionSpec as P
+
+        from galvatron_tpu.models.base import layer_param_specs
+        from galvatron_tpu.parallel import spec as S
+        from galvatron_tpu.parallel.mesh import layer_axes
+
+        if not isinstance(self.cfg, M.TransformerConfig):
+            return None
+        cfg = dataclasses.replace(self.cfg, num_layers=max(n, 1))
+        keys = jax.random.split(jax.random.PRNGKey(0), max(n, 1))
+        layers = [M.init_layer_params(kk, cfg) for kk in keys[:n]]
+        axes = [layer_axes(hp, j) for j in range(n)]
+        layers = [
+            jax.device_put(lp, jax.tree.map(
+                lambda sp: S.named(mesh, sp), layer_param_specs(cfg, ax),
+                is_leaf=lambda v: isinstance(v, P),
+            ))
+            for lp, ax in zip(layers, axes)
+        ]
+        x = jax.random.normal(jax.random.PRNGKey(1), (bsz, seq, cfg.hidden_size), self._dtype)
+        positions = jnp.broadcast_to(jnp.arange(seq), (bsz, seq))
+
+        def fwd(layers, x):
+            for j, lp in enumerate(layers):
+                ax = axes[j]
+                x = S.constrain(x, mesh, S.act_spec(ax))
+                x = M.layer_forward(lp, x, positions, cfg, mesh=mesh, axes=ax)
+            return jnp.sum(x.astype(jnp.float32))
+
+        return fwd, layers, (x,)
 
     def _other_ms_per_sample(self, bsz: int, seq: int, per_layer_ms_sum: float) -> float:
         """Embedding + head + loss time: full tiny model minus its layers'
@@ -484,6 +501,59 @@ class T5ModelProfiler(ModelProfiler):
 
         return fwd, layers, (x,)
 
+    def _sharded_stack_t(self, t: int, n: int, bsz: int, seq: int, hp, mesh,
+                         kind: str):
+        """Per-strategy measurement for the enc/dec layer types (the
+        decoder's fixed encoder memory replicates across the mesh). Ring cp
+        needs a zigzag-permuted bias layout the profiler does not model;
+        fall back to the derivation for it."""
+        if kind == "cp":
+            return None
+        from jax.sharding import PartitionSpec as P
+
+        from galvatron_tpu.models import t5 as T
+        from galvatron_tpu.parallel import spec as S
+        from galvatron_tpu.parallel.mesh import layer_axes
+
+        cfg = dataclasses.replace(self.cfg, compute_dtype=self._dtype)
+        keys = jax.random.split(jax.random.PRNGKey(0), max(n, 1))
+        x = jax.random.normal(jax.random.PRNGKey(1), (bsz, seq, cfg.hidden_size), self._dtype)
+        table = jax.random.normal(
+            jax.random.PRNGKey(2), (cfg.rel_buckets, cfg.num_heads), jnp.float32
+        ) * 0.02
+        axes = [layer_axes(hp, j) for j in range(n)]
+        init = T.init_enc_layer if t == 0 else T.init_dec_layer
+        specs = T.enc_layer_specs if t == 0 else T.dec_layer_specs
+        layers = [
+            jax.device_put(init(kk, cfg), jax.tree.map(
+                lambda sp: S.named(mesh, sp), specs(cfg, ax),
+                is_leaf=lambda v: isinstance(v, P),
+            ))
+            for kk, ax in zip(keys[:n], axes)
+        ]
+        bias = T.rel_bias(table, seq, seq, cfg, bidirectional=(t == 0))
+        if t == 0:
+            def fwd(layers, x):
+                for j, lp in enumerate(layers):
+                    ax = axes[j]
+                    x = S.constrain(x, mesh, S.act_spec(ax))
+                    x = T.enc_layer_forward(lp, x, cfg, bias, mesh=mesh, axes=ax)
+                return jnp.sum(x.astype(jnp.float32))
+
+            return fwd, layers, (x,)
+        enc_out = jax.random.normal(
+            jax.random.PRNGKey(3), (bsz, seq, cfg.hidden_size), self._dtype
+        )
+
+        def fwd(layers, x):
+            for j, lp in enumerate(layers):
+                ax = axes[j]
+                x = S.constrain(x, mesh, S.act_spec(ax))
+                x = T.dec_layer_forward(lp, x, enc_out, cfg, bias, mesh=mesh, axes=ax)
+            return jnp.sum(x.astype(jnp.float32))
+
+        return fwd, layers, (x,)
+
     def _layer_param_bytes(self, t: int) -> int:
         from galvatron_tpu.models import t5 as T
 
@@ -540,6 +610,45 @@ class SwinModelProfiler(ModelProfiler):
     @property
     def layer_types(self):  # type: ignore[override]
         return self.cfg.num_stages
+
+    def _sharded_stack_t(self, t: int, n: int, bsz: int, seq: int, hp, mesh,
+                         kind: str):
+        """Per-strategy measurement for swin blocks. Only tp applies (window
+        attention has no sequence dim to shard: cp/ulysses fall back)."""
+        if kind != "tp":
+            return None
+        from jax.sharding import PartitionSpec as P
+
+        from galvatron_tpu.models import swin as W
+        from galvatron_tpu.parallel import spec as S
+        from galvatron_tpu.parallel.mesh import layer_axes
+
+        cfg = dataclasses.replace(self.cfg, compute_dtype=self._dtype)
+        if cfg.num_heads[t] % max(hp.layers[0].tp, 1) != 0:
+            return None
+        res = cfg.stage_resolution(t)
+        keys = jax.random.split(jax.random.PRNGKey(0), max(n, 1))
+        axes = [layer_axes(hp, j) for j in range(n)]
+        layers = [
+            jax.device_put(W.init_block_params(kk, cfg, t), jax.tree.map(
+                lambda sp: S.named(mesh, sp), W.block_param_specs(cfg, t, ax),
+                is_leaf=lambda v: isinstance(v, P),
+            ))
+            for kk, ax in zip(keys[:n], axes)
+        ]
+        x = jax.random.normal(
+            jax.random.PRNGKey(1), (bsz, res, res, cfg.stage_dim(t)), self._dtype
+        )
+
+        def fwd(layers, x):
+            for j, lp in enumerate(layers):
+                x = W.block_forward(
+                    lp, x, cfg=cfg, stage=t, shift=(j % 2 == 1),
+                    mesh=mesh, axes=axes[j],
+                )
+            return jnp.sum(x.astype(jnp.float32))
+
+        return fwd, layers, (x,)
 
     def _stack_t(self, t: int, n: int, bsz: int, seq: int, remat: bool = False):
         # `seq` is ignored: each stage has a fixed resolution from the config

@@ -175,3 +175,37 @@ def test_t5_profiler_batch_mode(tmp_path):
     res = T5ModelProfiler(cfg, "t5", args).profile_computation()
     for key in ("layertype_0", "layertype_1"):
         assert isinstance(res[key], list) and len(res[key]) == 2, res[key]
+
+
+def test_t5_swin_measured_tp_activation_rows(devices8):
+    """The per-strategy activation measurement covers the multi-layer-type
+    families too: t5 enc/dec (tp + ulysses) and swin blocks (tp) measure on
+    a k-device mesh; inapplicable strategies fall back (None)."""
+    import jax.numpy as jnp
+
+    from galvatron_tpu.models.t5 import t5_config
+    from galvatron_tpu.models.swin import swin_config
+    from galvatron_tpu.profiler.model import SwinModelProfiler, T5ModelProfiler
+
+    tcfg = t5_config(
+        "t5-test", hidden_size=32, num_heads=2, head_dim=16, ffn_hidden=64,
+        num_enc_layers=2, num_dec_layers=2, vocab_size=64, max_seq_len=16,
+        compute_dtype=jnp.float32,
+    )
+    targs = ModelProfileArgs(profile_batch_size=2, layernum_min=1, layernum_max=2,
+                             warmup=0, iters=1, max_tp_deg=2, mixed_precision="fp32")
+    tp = T5ModelProfiler(tcfg, "t5", targs)
+    assert tp._act_bytes_tp(0, 2, 16, 2, kind="tp")      # encoder, megatron-sp
+    assert tp._act_bytes_tp(1, 2, 16, 2, kind="tp")      # decoder (cross-attn)
+    assert tp._act_bytes_tp(0, 2, 16, 2, kind="ulysses")
+    assert tp._act_bytes_tp(0, 2, 16, 2, kind="cp") is None  # documented fallback
+
+    scfg = swin_config(
+        "swin-test", embed_dim=16, depths=(1, 1), num_heads=(2, 2),
+        image_size=16, patch_size=4, window=4, num_classes=4,
+        compute_dtype=jnp.float32,
+    )
+    sp = SwinModelProfiler(scfg, "swin", targs)
+    assert sp._act_bytes_tp(0, 2, 16, 2, kind="tp")
+    assert sp._act_bytes_tp(1, 2, 16, 2, kind="tp")
+    assert sp._act_bytes_tp(0, 2, 16, 2, kind="cp") is None
