@@ -43,7 +43,8 @@ def test_t5_profile_search_train(tmp_path, devices8):
         ["--model_type", "t5", "--model_size", "t5-test", "--config_dir", d,
          "--memory_constraint", "8", "--max_pp_deg_search", "2",
          "--max_tp_deg_search", "2", "--settle_bsz", "8", "--mixed_precision",
-         "bf16", "--output_config_path", strategy_path] + SEQ_ARGS
+         "bf16", "--output_config_path", strategy_path,
+         "--log_dir", os.path.join(d, "logs")] + SEQ_ARGS
     )
     assert res["strategies"] is not None and len(res["strategies"]) == 4  # t5-test: 2 enc + 2 dec
     assert os.path.exists(strategy_path)
