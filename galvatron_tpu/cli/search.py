@@ -88,6 +88,8 @@ def search(args, world_size: Optional[int] = None) -> dict:
         model_layer_configs=layer_cfgs,
         config_dir=args.config_dir,
         model_name=args.model_type,
+        align_type_boundaries=not fam.mid_stage_type_boundaries,
+        allow_sequence_sharding=fam.supports_sequence_sharding,
     )
     mp = _model_paths(args, fam, cfg)
     engine.set_model_profiles(

@@ -37,9 +37,21 @@ class ModelFamily:
     # optional (cfg, model_name, args) -> profiler instance overriding the
     # generic ModelProfiler (t5/swin)
     make_profiler: Optional[Callable] = None
+    # whether the family's pipeline engine accepts layer-type boundaries that
+    # fall mid-stage (swin: patch merges may land inside a stage; enc-dec:
+    # the encoder/decoder boundary must align with a stage boundary). The
+    # search engine keys its multi-layer-type feasibility filter on this.
+    mid_stage_type_boundaries: bool = False
+    # whether the family's attention has a sequence dimension that ring-cp /
+    # ulysses-sp can shard (swin windowed attention does not —
+    # validate_swin_config); False drops cp/sp strategies from the search
+    supports_sequence_sharding: bool = True
 
 
 _REGISTRY: Dict[str, ModelFamily] = {}
+# families whose module failed to import, mapped to the import traceback —
+# surfaced loudly at get_family() instead of silently vanishing
+_BROKEN: Dict[str, str] = {}
 
 
 def register(family: ModelFamily):
@@ -49,8 +61,21 @@ def register(family: ModelFamily):
 
 def get_family(name: str) -> ModelFamily:
     _ensure_builtin()
+    if name in _BROKEN:
+        raise ImportError(
+            "model family %r failed to import:\n%s" % (name, _BROKEN[name])
+        )
     if name not in _REGISTRY:
-        raise KeyError("unknown model family %r; known: %s" % (name, sorted(_REGISTRY)))
+        # _BROKEN is keyed by MODULE name; a module may register families under
+        # other names, so point at any recorded import failures here too
+        broken_note = (
+            " (modules that failed to import: %s)" % sorted(_BROKEN)
+            if _BROKEN else ""
+        )
+        raise KeyError(
+            "unknown model family %r; known: %s%s"
+            % (name, sorted(_REGISTRY), broken_note)
+        )
     return _REGISTRY[name]
 
 
@@ -123,9 +148,26 @@ def _ensure_builtin():
             config_from_hf=_fa(llama.llama_config_from_hf),
         )
     )
-    # extended families (bert/vit/t5/swin) self-register on import
+    # extended families (bert/vit/t5/swin) self-register on import; a broken
+    # module is recorded (not swallowed) and re-raised at get_family() so a
+    # broken family surfaces at use time instead of vanishing from the registry
+    import traceback
+    import warnings
+
     for mod in ("bert", "vit", "t5", "swin"):
         try:
             __import__("galvatron_tpu.models.%s" % mod)
-        except ImportError:
-            pass
+        except Exception:
+            # ANY import-time failure (ImportError, NameError, SyntaxError...)
+            # must not take down the registry for the healthy families
+            tb = traceback.format_exc()
+            _BROKEN[mod] = tb
+            try:
+                warnings.warn(
+                    "model family %r failed to import and will raise at use "
+                    "time: %s" % (mod, tb.strip().splitlines()[-1])
+                )
+            except Exception:
+                # -W error must not abort registration of the remaining
+                # families; the traceback is still surfaced at get_family
+                pass

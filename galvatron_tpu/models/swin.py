@@ -475,6 +475,11 @@ def construct_swin_model(cfg: SwinConfig, hp: HybridParallelConfig, devices=None
             "hp covers %d layers but swin has %d blocks (depths %s)"
             % (len(hp.layers), cfg.num_layers, list(cfg.depths))
         )
+    # cp/sp are inapplicable at ANY pp degree (windowed attention has no
+    # sequence dimension): validate unconditionally, not just under pp>1
+    from galvatron_tpu.parallel.pipeline_1f1b_swin import validate_swin_config
+
+    validate_swin_config(cfg, hp)
     for i, ls in enumerate(hp.layers):
         nh = cfg.num_heads[cfg.stage_of_block(i)]
         if ls.tp > 1 and nh % ls.tp != 0:
@@ -495,10 +500,8 @@ def construct_swin_model(cfg: SwinConfig, hp: HybridParallelConfig, devices=None
             make_swin_loss_and_grad,
             stack_swin_layer_specs,
             stack_swin_params,
-            validate_swin_config,
         )
 
-        validate_swin_config(cfg, hp)
         specs = {
             k: v for k, v in swin_param_specs(cfg, hp).items() if k != "blocks" and k != "merges"
         }
@@ -570,6 +573,8 @@ def _register():
             build=construct_swin_model,
             layer_configs_fn=_swin_layer_configs,
             make_profiler=_swin_profiler,
+            mid_stage_type_boundaries=True,
+            supports_sequence_sharding=False,
         )
     )
 

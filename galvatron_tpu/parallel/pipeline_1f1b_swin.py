@@ -46,6 +46,14 @@ Params = Dict[str, Any]
 
 
 def validate_swin_config(cfg, hp: HybridParallelConfig) -> None:
+    # cp/sp are inapplicable at ANY pp degree (windowed attention has no
+    # sequence dimension) — check before the pp early-return
+    for s in hp.layers:
+        if s.cp > 1 or s.sp:
+            raise ValueError(
+                "swin windowed attention has no sequence dimension to shard: "
+                "cp / ulysses-sp do not apply (strategy %r)" % (s,)
+            )
     if hp.pp <= 1:
         return
     div = hp.pp_division
@@ -53,12 +61,6 @@ def validate_swin_config(cfg, hp: HybridParallelConfig) -> None:
         raise ValueError(
             "swin 1F1B requires equal layers per stage, got pp_division=%s" % (div,)
         )
-    for s in hp.layers:
-        if s.cp > 1 or s.sp:
-            raise ValueError(
-                "swin windowed attention has no sequence dimension to shard: "
-                "cp / ulysses-sp do not apply (strategy %r)" % (s,)
-            )
 
 
 # ------------------------------------------------------------- shape algebra

@@ -516,14 +516,17 @@ class OtherTimeCostModel:
             bct = fct * pha.bct_fct_coe
 
             def tp_msg(seq_len: float) -> float:
+                """ONE one-way vocab-tp activation message (embed fwd allreduce
+                OR head bwd allreduce; reference per_tp_message_time,
+                cost_model.py:533-563 — no fwd+bwd doubling)."""
                 if k <= 1 or vsp:
                     return 0.0
                 msg_mb = mbsz * seq_len * ma.hidden_size * (
                     2 if ta.mixed_precision else 4
                 ) / 1024 / 1024
                 if pha.allreduce_dict:
-                    return 2 * _table_time(pha.allreduce_dict, k, msg_mb)
-                return 2 * (k - 1) / k * msg_mb * comm_coe(pha.comm_coe_dict, k)
+                    return _table_time(pha.allreduce_dict, k, msg_mb)
+                return (k - 1) / k * msg_mb * comm_coe(pha.comm_coe_dict, k)
 
             # vocab dp group + ms/MB coefficient for the grad sync
             dp_deg = max(world_size // pp_deg // (1 if vsp else k), 1)
@@ -538,7 +541,10 @@ class OtherTimeCostModel:
             if pp_deg == 1:
                 states = get(pp_off.get("model_states", {}), 1 if vsp else k)
                 cf, cb = dp_sync(states)
-                tp_t = tp_msg(seqs[0]) + (tp_msg(seqs[-1]) if len(seqs) > 1 else tp_msg(seqs[0]))
+                # reference tp_time at pp=1: sum over seqs + last again
+                # (cost_model.py:566-567 "For T5 model") — for a single-seq
+                # model this is 2 messages: embed fwd + head bwd allreduce
+                tp_t = sum(tp_msg(s) for s in seqs) + tp_msg(seqs[-1])
                 self.cost[k] = [overlap(cf, fct) + overlap(cb, bct) + tp_t]
             else:
                 first = pp_on.get("first_stage", {})

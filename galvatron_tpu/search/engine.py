@@ -220,6 +220,8 @@ class GalvatronSearchEngine:
         config_dir: str = "configs",
         model_name: str = "model",
         logger=None,
+        align_type_boundaries: bool = True,
+        allow_sequence_sharding: bool = True,
     ):
         self.args = args
         self.world_size = world_size
@@ -228,6 +230,15 @@ class GalvatronSearchEngine:
         self.config_dir = config_dir
         self.model_name = model_name
         self.logger = logger
+        # multi-layer-type families whose pipeline engine accepts mid-stage
+        # type boundaries (swin patch merges) set this False via the family's
+        # mid_stage_type_boundaries flag; enc-dec keeps True (the
+        # encoder/decoder boundary must land on a stage boundary)
+        self.align_type_boundaries = align_type_boundaries
+        # families without a shardable sequence dimension (swin, via the
+        # supports_sequence_sharding family flag) get cp/ulysses-sp strategies
+        # filtered at ANY pp degree — they are unrunnable, not misaligned
+        self.allow_sequence_sharding = allow_sequence_sharding
         self.strategies: List[list] = []
         self.optimal_chunk_func = None
 
@@ -402,6 +413,10 @@ class GalvatronSearchEngine:
         def ok(s):
             if s[2] > bsz or bsz % s[2] != 0:
                 return False
+            if not self.allow_sequence_sharding:
+                info = s[3] if len(s) > 3 else {}
+                if info.get("cp", 1) > 1 or info.get("sp", 0):
+                    return False
             if s[0] > 1 and (bsz // chunks) % s[2] != 0:
                 return False
             if s[0] > 1:
@@ -416,15 +431,20 @@ class GalvatronSearchEngine:
                     if n_layers % s[0] != 0 and (s[3] if len(s) > 3 else {}).get("cp", 1) > 1:
                         return False
                 else:
-                    # multi-type engines: equal layers per stage, every
-                    # layer-type boundary on a stage boundary, and no ring cp
-                    # (pipeline_1f1b_encdec/swin validate_*_config reject it)
+                    # multi-type engines: equal layers per stage and no ring
+                    # cp (pipeline_1f1b_encdec/swin validate_*_config reject
+                    # it). Type-boundary/stage-boundary alignment is only
+                    # required when the family says so (enc-dec yes; swin
+                    # supports mid-stage patch merges but no ulysses sp —
+                    # validate_swin_config)
                     if (s[3] if len(s) > 3 else {}).get("cp", 1) > 1:
                         return False
                     if n_layers % s[0] != 0:
                         return False
                     lps = n_layers // s[0]
-                    if any(b % lps != 0 for b in type_bounds):
+                    if self.align_type_boundaries and any(
+                        b % lps != 0 for b in type_bounds
+                    ):
                         return False
             if not (min_tp <= s[1] <= max_tp):
                 return False

@@ -1,0 +1,179 @@
+"""Step-time breakdown on the real TPU chip (VERDICT r3 item 1).
+
+Times the bench.py train-step's components separately so the MFU work targets
+the real bottleneck. Methodology matches bench.py: differenced / min-of-round
+timings; every measured call iterates the op K times inside one jit (lax.scan)
+so the ~70 ms axon-tunnel dispatch latency amortises away.
+
+Usage: python scripts/profile_step.py [--quick]
+"""
+
+import argparse
+import time
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from galvatron_tpu.models import base as M
+
+HIDDEN, FFN, HEADS, SEQ = 4096, 11008, 32, 2048
+LAYERS, BATCH = 2, 4
+
+
+def cfg_():
+    return M.TransformerConfig(
+        hidden_size=HIDDEN, num_heads=HEADS, num_layers=LAYERS,
+        ffn_hidden=FFN, vocab_size=256, max_seq_len=SEQ,
+        norm_type="rmsnorm", activation="swiglu", position_type="rope",
+        qkv_bias=False, mlp_bias=False, out_bias=False,
+        compute_dtype=jnp.bfloat16, param_dtype=jnp.float32,
+    )
+
+
+def sync(x):
+    return float(jnp.sum(jax.tree.leaves(x)[0].astype(jnp.float32)))
+
+
+def timeit(fn, *args, iters=5, warmup=2):
+    for _ in range(warmup):
+        sync(fn(*args))
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        sync(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return float(np.min(ts))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+    iters = 3 if args.quick else 6
+
+    cfg = cfg_()
+    key = jax.random.PRNGKey(0)
+    layers = [M.init_layer_params(k, cfg) for k in jax.random.split(key, LAYERS)]
+    x = jax.random.normal(jax.random.PRNGKey(1), (BATCH, SEQ, HIDDEN), jnp.bfloat16)
+    positions = jnp.broadcast_to(jnp.arange(SEQ), (BATCH, SEQ))
+    tx = optax.adam(1e-4)
+    opt_state = tx.init(layers)
+
+    def loss_fn(layers, x):
+        y = x
+        for lp in layers:
+            y = M.layer_forward(lp, y, positions, cfg)
+        return jnp.mean(y.astype(jnp.float32) ** 2)
+
+    # ---- full step (donated) — the bench metric
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def step(layers, opt_state, x):
+        loss, grads = jax.value_and_grad(loss_fn)(layers, x)
+        updates, opt_state = tx.update(grads, opt_state, layers)
+        layers = optax.apply_updates(layers, updates)
+        return layers, opt_state, loss
+
+    # time the full step WITHOUT donation-safe reuse issues: run pairs
+    def run_step():
+        nonlocal layers, opt_state
+        layers, opt_state, loss = step(layers, opt_state, x)
+        return loss
+
+    for _ in range(2):
+        sync(run_step())
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        sync(run_step())
+        ts.append(time.perf_counter() - t0)
+    t_step = float(np.min(ts))
+
+    # ---- forward only
+    fwd = jax.jit(loss_fn)
+    t_fwd = timeit(fwd, layers, x, iters=iters)
+
+    # ---- forward + backward (no optimizer)
+    grad = jax.jit(jax.value_and_grad(loss_fn))
+    t_grad = timeit(lambda l, xx: grad(l, xx)[1], layers, x, iters=iters)
+
+    # ---- optimizer only (fixed grads)
+    grads = jax.jit(jax.grad(loss_fn))(layers, x)
+    sync(grads)
+
+    @jax.jit
+    def adam_only(grads, opt_state, layers):
+        updates, new_state = tx.update(grads, opt_state, layers)
+        return optax.apply_updates(layers, updates), new_state
+
+    t_adam = timeit(lambda g, s, l: adam_only(g, s, l)[0], grads, opt_state, layers, iters=iters)
+
+    # ---- attention fwd+bwd isolated (scan K inner iters to amortise dispatch)
+    K = 8
+    q = jax.random.normal(jax.random.PRNGKey(2), (BATCH, SEQ, HEADS, 128), jnp.bfloat16)
+
+    from galvatron_tpu.ops.attention import core_attention
+
+    def attn_loss(q):
+        return jnp.mean(core_attention(q, q, q, causal=True).astype(jnp.float32) ** 2)
+
+    attn_grad = jax.grad(attn_loss)
+
+    @jax.jit
+    def attn_bwd_k(q):
+        def body(c, _):
+            g = attn_grad(c)
+            return c + 1e-6 * g, ()
+        out, _ = jax.lax.scan(body, q, None, length=K)
+        return out
+
+    @jax.jit
+    def attn_fwd_k(q):
+        def body(c, _):
+            o = core_attention(c, c, c, causal=True)
+            return c + 1e-6 * o, ()
+        out, _ = jax.lax.scan(body, q, None, length=K)
+        return out
+
+    t_attn_f = timeit(attn_fwd_k, q, iters=iters) / K
+    t_attn_fb = timeit(attn_bwd_k, q, iters=iters) / K
+
+    # ---- big matmul ceiling: one (B*S, H) x (H, FFN) matmul chain, K iters
+    w1 = jax.random.normal(jax.random.PRNGKey(3), (HIDDEN, FFN), jnp.bfloat16)
+
+    @jax.jit
+    def mm_k(a, w):
+        def body(c, _):
+            y = c @ w
+            return c + 1e-6 * (y @ w.T), ()
+        out, _ = jax.lax.scan(body, a, None, length=K)
+        return out
+
+    a = x.reshape(-1, HIDDEN)
+    t_mm = timeit(mm_k, a, w1, iters=iters) / K
+    mm_flops = 2 * 2 * a.shape[0] * HIDDEN * FFN  # fwd+transpose matmuls
+
+    n_params = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(layers))
+    tokens = BATCH * SEQ
+    flops_step = 6.0 * n_params * tokens + 12 * LAYERS * SEQ * HIDDEN * tokens * 0.5
+    peak = 197e12
+    print("device:", jax.devices()[0].device_kind)
+    print("params: %.1fM  tokens/step: %d" % (n_params / 1e6, tokens))
+    print("full step : %7.2f ms   (MFU %.3f)" % (t_step * 1e3, flops_step / t_step / peak))
+    print("fwd only  : %7.2f ms   (MFU %.3f)" % (t_fwd * 1e3, flops_step / 3 / t_fwd / peak))
+    print("fwd+bwd   : %7.2f ms   (MFU %.3f)" % (t_grad * 1e3, flops_step / t_grad / peak))
+    print("adam only : %7.2f ms" % (t_adam * 1e3))
+    print("residual (step - fwdbwd - adam): %7.2f ms" % ((t_step - t_grad - t_adam) * 1e3))
+    attn_flops = 4 * BATCH * HEADS * SEQ * SEQ * 128 * 0.5  # causal qk+pv
+    print("attn fwd  : %7.2f ms   (%.0f%% of kernel peak)" % (
+        t_attn_f * 1e3, 100 * attn_flops / t_attn_f / peak))
+    print("attn f+b  : %7.2f ms   (%.0f%% of kernel peak)" % (
+        t_attn_fb * 1e3, 100 * 3 * attn_flops / t_attn_fb / peak))
+    print("mm pair   : %7.2f ms   (%.0f%% peak)" % (t_mm * 1e3, 100 * mm_flops / t_mm / peak))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,30 @@
+"""Registry failure surfacing: a family module that fails to import must
+raise loudly at get_family() instead of silently vanishing (advisor/VERDICT
+r3; reference has no analogue — its per-family imports are eager)."""
+
+import pytest
+
+from galvatron_tpu.models import registry
+
+pytestmark = [pytest.mark.model]
+
+
+def test_builtin_families_present():
+    names = registry.family_names()
+    for fam in ("gpt", "llama", "gpt_fa", "llama_fa", "bert", "vit", "t5", "swin"):
+        assert fam in names
+
+
+def test_broken_family_raises_at_get_family():
+    registry._ensure_builtin()
+    registry._BROKEN["fakefam"] = "Traceback ...\nImportError: no such module"
+    try:
+        with pytest.raises(ImportError, match="fakefam"):
+            registry.get_family("fakefam")
+    finally:
+        registry._BROKEN.pop("fakefam", None)
+
+
+def test_unknown_family_still_keyerror():
+    with pytest.raises(KeyError):
+        registry.get_family("definitely_not_a_family")

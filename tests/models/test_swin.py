@@ -90,3 +90,17 @@ def test_swin_block_count_mismatch_raises():
     hp = HybridParallelConfig.uniform(8, 3, global_bsz=8)
     with pytest.raises(ValueError, match="4 blocks"):
         construct_swin_model(cfg, hp)
+
+
+def test_swin_rejects_cp_sp_at_pp1():
+    """cp/ulysses-sp are inapplicable to windowed attention at ANY pp degree;
+    construct must reject them even without a pipeline (code-review r4)."""
+    from galvatron_tpu.config.strategy import HybridParallelConfig, LayerStrategy
+
+    cfg = swin_config("swin-tiny", embed_dim=16, depths=(2, 2), num_heads=(2, 4),
+                      image_size=32, patch_size=4, window=4)
+    hp = HybridParallelConfig(world_size=8, pp=1,
+                              layers=[LayerStrategy(tp=2, sp=1)] * 4,
+                              global_bsz=8, chunks=1)
+    with pytest.raises(ValueError, match="sequence dimension"):
+        construct_swin_model(cfg, hp)

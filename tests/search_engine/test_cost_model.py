@@ -125,12 +125,12 @@ def test_comm_coe_placement():
 
 # ---------------------------------------------------------- other-time model
 def ot(pp_deg, embed_sdp=False, vsp=0, dp_overlap_coe=1.2, min_tp=1, max_tp=4,
-       allreduce_dict=None):
+       allreduce_dict=None, seqs=None):
     from galvatron_tpu.search.cost_model import OtherTimeCostModel
 
     return OtherTimeCostModel(
         mbsz=2, pp_deg=pp_deg, world_size=8, vsp=vsp, embed_sdp=embed_sdp,
-        min_tp=min_tp, max_tp=max_tp, sequence_length_list=[2048],
+        min_tp=min_tp, max_tp=max_tp, sequence_length_list=seqs or [2048],
         model_args=ModelArgs(hidden_size=4096),
         train_args=TrainArgs(),
         parallel_args=ParallelArgs(),
@@ -191,3 +191,29 @@ def test_other_time_dp_sync_overlaps_compute():
     slow_net = ot(pp_deg=1, dp_overlap_coe=2.0)
     for k in fast_net:
         assert sum(slow_net[k]) >= sum(fast_net[k]) - 1e-9
+
+
+def test_other_time_pp1_single_seq_charges_tp_msg_once():
+    """pp=1 charges two one-way messages (embed fwd allreduce + head bwd
+    allreduce) via the reference's sum(seqs)+last rule — tp_msg itself is ONE
+    message with no internal fwd+bwd doubling (advisor r3; reference
+    estimate_tp_time, cost_model.py:533-567)."""
+    table_free = {"2": {"popt": [0.0, 0.0]}, "4": {"popt": [0.0, 0.0]}}
+    table_paid = {"2": {"popt": [0.01, 0.1]}, "4": {"popt": [0.01, 0.1]}}
+    free = ot(pp_deg=1, allreduce_dict=table_free)
+    paid = ot(pp_deg=1, allreduce_dict=table_paid)
+    msg_mb = 2 * 2048 * 4096 * 2 / 1024 / 1024  # mbsz x seq x hidden, bf16
+    two_msgs = 2 * (0.01 * msg_mb + 0.1)  # embed fwd + head bwd allreduce
+    assert sum(paid[2]) - sum(free[2]) == pytest.approx(two_msgs)
+    # multi-seq (T5-style): reference sums all seqs + last again
+    paid2 = ot(pp_deg=1, allreduce_dict=table_paid, seqs=[2048, 1024])
+    free2 = ot(pp_deg=1, allreduce_dict=table_free, seqs=[2048, 1024])
+    msg_mb_dec = 2 * 1024 * 4096 * 2 / 1024 / 1024
+    t5_total = (0.01 * msg_mb + 0.1) + 2 * (0.01 * msg_mb_dec + 0.1)
+    assert sum(paid2[2]) - sum(free2[2]) == pytest.approx(t5_total)
+    # pp>1 per-stage parity: each vocab stage pays exactly ONE message
+    paid_pp = ot(pp_deg=2, allreduce_dict=table_paid)
+    free_pp = ot(pp_deg=2, allreduce_dict=table_free)
+    one_msg = 0.01 * msg_mb + 0.1
+    assert paid_pp[2][0] - free_pp[2][0] == pytest.approx(one_msg)
+    assert paid_pp[2][-1] - free_pp[2][-1] == pytest.approx(one_msg)

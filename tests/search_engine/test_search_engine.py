@@ -314,3 +314,68 @@ def test_uneven_pp_division_searched_and_trains(devices8):
     ))
     p, st, mets = step(p, st, batch)
     assert np.isfinite(float(mets["loss"]))
+
+
+def test_mid_stage_type_boundary_flag_relaxes_filter():
+    """Families whose pipeline engine accepts mid-stage layer-type boundaries
+    (swin patch merges, validate_swin_config) must not lose pp configs to the
+    enc-dec alignment requirement (advisor r3): depths like (1,3) at pp=2 put
+    the type boundary inside stage 0 yet are runnable."""
+    layer_cfgs = [
+        {"hidden_size": 4096, "seq_len": 2048, "layer_num": 1},
+        {"hidden_size": 4096, "seq_len": 2048, "layer_num": 3},
+    ]
+    time_cfg = {"layertype_0": 5.3, "layertype_1": 5.3, "other_time": 2.0}
+    mem_cfg = dict(MEMORY_CONFIG)
+    mem_cfg["layertype_1"] = MEMORY_CONFIG["layertype_0"]
+
+    def run(align):
+        eng = GalvatronSearchEngine(
+            SearchArgs(memory_constraint=16.0, settle_bsz=8, settle_chunk=1,
+                       search_space="pp", max_pp_deg=2),
+            2, layer_cfgs, model_name="mock_midstage",
+            align_type_boundaries=align,
+        )
+        eng.set_model_profiles(time_cfg, mem_cfg)
+        eng.set_hardware_profiles(ALLREDUCE_BW, P2P_BW, {"overlap_coe": 1.12})
+        eng.initialize_search_engine()
+        return eng.parallelism_optimization()
+
+    assert run(True) is None  # boundary at layer 1, lps=2 -> filtered out
+    relaxed = run(False)
+    assert relaxed is not None and relaxed["pp"] == 2
+
+
+def test_no_sequence_sharding_filters_sp_at_any_pp():
+    """Families without a shardable sequence dimension (swin,
+    supports_sequence_sharding=False) must not receive cp/ulysses-sp
+    strategies even at pp=1, where validate_swin_config is the only other
+    line of defense (code-review r4)."""
+
+    def run(allow):
+        args = SearchArgs(memory_constraint=16.0, settle_bsz=16, settle_chunk=2,
+                          sp_space="sp", max_tp_deg=8, max_pp_deg=1)
+        eng = GalvatronSearchEngine(
+            args, 8, [{"hidden_size": 4096, "seq_len": 2048, "layer_num": 8}],
+            model_name="mock_noseq", allow_sequence_sharding=allow,
+        )
+        eng.set_model_profiles(TIME_CONFIG, MEMORY_CONFIG)
+        sp_tables = {
+            "allreduce": {str(k): {"popt": [0.01, 0.05]} for k in (2, 4, 8)},
+            "all2all": {str(k): {"popt": [0.005, 0.05]} for k in (2, 4, 8)},
+        }
+        eng.set_hardware_profiles(ALLREDUCE_BW, P2P_BW, {"overlap_coe": 1.12},
+                                  sp_tables)
+        eng.initialize_search_engine()
+        return eng.parallelism_optimization()
+
+    allowed = run(True)
+    assert allowed is not None and any(
+        s[3].get("sp") for s in allowed["strategies"] if len(s) > 3
+    )
+    blocked = run(False)
+    # sp-only space with sp filtered out: only sp-free strategies (tp=1
+    # carries no sp flag) or nothing may be emitted
+    assert blocked is None or not any(
+        s[3].get("sp") for s in blocked["strategies"] if len(s) > 3
+    )
