@@ -76,6 +76,14 @@ def _add_train_args(p: argparse.ArgumentParser):
     g.add_argument("--lr_warmup_iters", type=int, default=0)
     g.add_argument("--seed", type=int, default=1234)
     g.add_argument("--data_path", type=str, default=None, help="indexed dataset prefix; default: synthetic data")
+    g.add_argument("--split", type=str, default="969,30,1",
+                   help="train/valid/test document weights over --data_path "
+                   "(Megatron --split semantics)")
+    g.add_argument("--eval_interval", type=int, default=0,
+                   help="run a valid-split eval pass every N iterations (0=off)")
+    g.add_argument("--eval_iters", type=int, default=5,
+                   help="batches averaged per eval pass (and for the final "
+                   "test-split eval)")
     g.add_argument("--profile", type=int, default=0, help="enable the runtime profiler")
     g.add_argument("--train_log_dir", type=str, default=None,
                    help="tee rank-0 iteration stats to <dir>/train_<model>.log")

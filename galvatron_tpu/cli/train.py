@@ -41,11 +41,17 @@ def optimizer_args_from(args) -> OptimizerArgs:
     )
 
 
-def build_data_iterator(args, fam, cfg, hp, start_step: int = 0):
+def build_data_iterator(args, fam, cfg, hp, start_step: int = 0,
+                        split: str = "train"):
     """Per-family input pipeline (fam.data_kind): indexed dataset when
     --data_path is given, synthetic stream otherwise (the reference models'
     random-data fallback). All streams are pure functions of the step index,
-    so `start_step` resumes in O(1)."""
+    so `start_step` resumes in O(1). `split` selects the train/valid/test
+    document range (real data) or an independent stream (synthetic — the
+    reference's random splits are independent streams too)."""
+    # synthetic streams have no documents to split: derive a disjoint,
+    # deterministic stream per split from the seed
+    split_seed = args.seed + {"train": 0, "valid": 7919, "test": 15838}.get(split, 0)
     if args.data_path:
         if fam.data_kind != "lm":
             raise ValueError(
@@ -53,27 +59,28 @@ def build_data_iterator(args, fam, cfg, hp, start_step: int = 0):
                 "needs its own input pipeline (synthetic fallback runs without "
                 "--data_path)" % (fam.name, fam.data_kind)
             )
-        from galvatron_tpu.data.dataset import gpt_train_iterator
+        from galvatron_tpu.data.dataset import gpt_data_iterator
 
-        return gpt_train_iterator(
+        return gpt_data_iterator(
             args.data_path, hp, seq_len=cfg.max_seq_len, seed=args.seed,
-            start_step=start_step,
+            start_step=start_step, split=split,
+            split_weights=getattr(args, "split", "969,30,1"),
         )
     if fam.data_kind == "vision":
         from galvatron_tpu.runtime.dataloader import get_vision_train_iterator
 
         return get_vision_train_iterator(
-            hp, cfg.image_size, cfg.num_channels, cfg.num_classes, seed=args.seed,
+            hp, cfg.image_size, cfg.num_channels, cfg.num_classes, seed=split_seed,
             start_step=start_step,
         )
     if fam.data_kind == "seq2seq":
         from galvatron_tpu.runtime.dataloader import get_seq2seq_train_iterator
 
         return get_seq2seq_train_iterator(
-            hp, cfg.vocab_size, cfg.max_seq_len, cfg.max_seq_len, seed=args.seed,
+            hp, cfg.vocab_size, cfg.max_seq_len, cfg.max_seq_len, seed=split_seed,
             start_step=start_step,
         )
-    return get_train_iterator(hp, cfg.vocab_size, cfg.max_seq_len, seed=args.seed,
+    return get_train_iterator(hp, cfg.vocab_size, cfg.max_seq_len, seed=split_seed,
                               start_step=start_step)
 
 
@@ -114,6 +121,34 @@ def train(args) -> dict:
     # deterministic resume: streams are stateless functions of the step index
     # (the reference keeps Megatron dataset cursors in the optimizer checkpoint)
     data_iter = build_data_iterator(args, fam, cfg, hp, start_step=start_iter)
+
+    eval_interval = getattr(args, "eval_interval", 0) or 0
+    eval_iters = max(getattr(args, "eval_iters", 5) or 0, 1)
+    # Eval batches are materialised ONCE up front: every eval pass sees the
+    # same batches (steps 0..eval_iters of the split stream), the per-pass
+    # index rebuild is avoided, and an unusable split (--split weights that
+    # leave valid/test empty for this corpus) fails BEFORE training instead
+    # of crashing the final test eval. NB for pp>1 pipedream models
+    # model.loss_fn is the 1F1B grad_fn's loss — eval pays the backward too;
+    # a forward-only pipelined eval is a known cost optimisation.
+    eval_fn = None
+    eval_batches = {}
+    if eval_interval:
+        eval_fn = jax.jit(model.loss_fn)
+        for split in ("valid", "test"):
+            it = build_data_iterator(args, fam, cfg, hp, start_step=0, split=split)
+            eval_batches[split] = [
+                model.shard_batch(next(it)) for _ in range(eval_iters)
+            ]
+
+    def evaluate(params, split):
+        """Mean loss over the split's cached batches (reference
+        train_dist.py's evaluate-and-log pass; dataloader.py:4-20 builds the
+        valid/test splits it consumes)."""
+        total = 0.0
+        for b in eval_batches[split]:
+            total += float(eval_fn(params, b))
+        return total / eval_iters
     prof = RuntimeProfiler(
         warmup=min(2, max(args.train_iters - 1, 0)),
         rank=jax.process_index(),
@@ -122,6 +157,7 @@ def train(args) -> dict:
     )
 
     losses = []
+    valid_losses = []  # (iteration, mean valid loss)
     it = start_iter
     for it in range(start_iter, args.train_iters):
         batch = next(data_iter)
@@ -132,6 +168,11 @@ def train(args) -> dict:
         if args.profile or it % max(args.log_interval, 1) == 0:
             prof.log_iteration(it, metrics)
         losses.append(float(metrics["loss"]))
+        if eval_interval and (it + 1) % eval_interval == 0:
+            vloss = evaluate(params, "valid")
+            valid_losses.append((it + 1, vloss))
+            if jax.process_index() == 0:
+                print("iteration %d: valid loss %.6f" % (it + 1, vloss))
         if args.save and args.save_interval and (it + 1) % args.save_interval == 0:
             ckpt.save_checkpoint(args.save, it + 1, params, opt_state, hp,
                                  train_meta={"iteration": it + 1})
@@ -140,6 +181,11 @@ def train(args) -> dict:
                              train_meta={"iteration": it + 1})
     summary = prof.summary()
     summary["losses"] = losses
+    if eval_interval:
+        summary["valid_losses"] = valid_losses
+        summary["test_loss"] = evaluate(params, "test")
+        if jax.process_index() == 0:
+            print("final test loss %.6f" % summary["test_loss"])
     if args.profile and jax.process_index() == 0:
         print({k: v for k, v in summary.items() if k != "losses"})
     return summary

@@ -143,27 +143,55 @@ class IndexedDataset:
         return self.tokens[self.offsets[i] : self.offsets[i + 1]]
 
 
+def split_doc_ids(n_docs: int, split: str) -> Dict[str, np.ndarray]:
+    """Contiguous train/valid/test document ranges from a weight string like
+    "969,30,1" (Megatron --split semantics: get_train_valid_test_split_,
+    consumed by the reference's BlendedMegatronDatasetBuilder). Deterministic —
+    a pure function of (n_docs, split) — so a resumed run sees identical
+    splits."""
+    weights = [float(w) for w in split.split(",")]
+    if len(weights) != 3 or any(w < 0 for w in weights) or sum(weights) <= 0:
+        raise ValueError("--split needs three non-negative weights, got %r" % split)
+    total = sum(weights)
+    bounds = np.cumsum([0.0] + [w / total for w in weights])
+    edges = np.round(bounds * n_docs).astype(np.int64)
+    edges[-1] = n_docs
+    names = ("train", "valid", "test")
+    return {
+        name: np.arange(edges[i], edges[i + 1], dtype=np.int32)
+        for i, name in enumerate(names)
+    }
+
+
 class GPTDataset:
     """Sampled LM windows over an IndexedDataset (Megatron GPTDataset
     semantics: epoch-shuffled documents, overlapping seq_len+1 windows,
-    sample-level shuffle)."""
+    sample-level shuffle). `documents` restricts the dataset to a doc-id
+    subset (the split ranges from split_doc_ids)."""
 
     def __init__(self, indexed: IndexedDataset, seq_len: int, n_samples: int,
-                 seed: int = 1234):
+                 seed: int = 1234, documents: Optional[np.ndarray] = None):
         self.indexed = indexed
         self.seq_len = seq_len
         self.seed = seed
-        doc_lens = indexed.doc_lens
+        self.documents = (
+            np.arange(indexed.n_docs, dtype=np.int32)
+            if documents is None else np.asarray(documents, np.int32)
+        )
+        if len(self.documents) == 0:
+            raise ValueError("empty document subset (check the --split weights)")
+        doc_lens = indexed.doc_lens[self.documents]
         total_tokens = int(doc_lens.sum())
         if total_tokens <= seq_len:
             raise ValueError(
-                "corpus has %d tokens; need > seq_len=%d" % (total_tokens, seq_len)
+                "split has %d tokens; need > seq_len=%d" % (total_tokens, seq_len)
             )
         samples_per_epoch = max((total_tokens - 1) // seq_len, 1)
         n_epochs = (n_samples + samples_per_epoch - 1) // samples_per_epoch + 1
         rng = np.random.RandomState(seed)
         doc_idx = np.concatenate([
-            rng.permutation(indexed.n_docs).astype(np.int32) for _ in range(n_epochs)
+            rng.permutation(len(self.documents)).astype(np.int32)
+            for _ in range(n_epochs)
         ])
         self.sample_idx = build_sample_idx(doc_lens, doc_idx, seq_len, n_samples)
         self.doc_idx = doc_idx
@@ -174,19 +202,20 @@ class GPTDataset:
     def __len__(self) -> int:
         return self.n_samples
 
+    def _doc(self, pos: int) -> np.ndarray:
+        return self.indexed.doc(int(self.documents[self.doc_idx[pos]]))
+
     def __getitem__(self, i: int) -> np.ndarray:
         """seq_len+1 tokens (inputs + shifted target)."""
         i = int(self.shuffle_idx[i % self.n_samples])
         (p0, o0), (p1, o1) = self.sample_idx[i], self.sample_idx[i + 1]
-        idx = self.indexed
         if p0 == p1:
-            chunk = idx.doc(self.doc_idx[p0])[o0 : o1 + 1]
-            parts = [chunk]
+            parts = [self._doc(p0)[o0 : o1 + 1]]
         else:
-            parts = [idx.doc(self.doc_idx[p0])[o0:]]
+            parts = [self._doc(p0)[o0:]]
             for p in range(p0 + 1, p1):
-                parts.append(idx.doc(self.doc_idx[p]))
-            parts.append(idx.doc(self.doc_idx[p1])[: o1 + 1])
+                parts.append(self._doc(p))
+            parts.append(self._doc(p1)[: o1 + 1])
         out = np.concatenate(parts)
         # the +1 target token may fall exactly on a boundary the walk did not
         # include (end of corpus walk); pad deterministically if so
@@ -195,20 +224,25 @@ class GPTDataset:
         return out[: self.seq_len + 1]
 
 
-def gpt_train_iterator(
+def gpt_data_iterator(
     data_path: str,
     hp: HybridParallelConfig,
     seq_len: int,
     seed: int = 1234,
     n_samples: Optional[int] = None,
     start_step: int = 0,
+    split: str = "train",
+    split_weights: str = "969,30,1",
 ) -> Iterator[Dict[str, jnp.ndarray]]:
-    """Deterministic batch stream for the train driver (--data_path). Batch
-    content is a pure function of the step index, so resume passes
-    `start_step` (O(1) skip)."""
+    """Deterministic batch stream over one split of the indexed dataset
+    (reference core/runtime/dataloader.py:4-20 builds all three splits).
+    Batch content is a pure function of the step index, so resume passes
+    `start_step` (O(1) skip); the split ranges are pure functions of the
+    corpus + weights, so resume sees the same split."""
+    indexed = IndexedDataset(data_path)
+    docs = split_doc_ids(indexed.n_docs, split_weights)[split]
     ds = GPTDataset(
-        IndexedDataset(data_path), seq_len,
-        n_samples or 1_000_000, seed=seed,
+        indexed, seq_len, n_samples or 1_000_000, seed=seed, documents=docs,
     )
     step = start_step
     while True:
@@ -216,3 +250,12 @@ def gpt_train_iterator(
         window = np.stack(rows)
         yield prepare_batch(hp, window[:, :-1], labels=window[:, 1:])
         step += 1
+
+
+def gpt_train_iterator(data_path, hp, seq_len, seed=1234, n_samples=None,
+                       start_step=0):
+    """Back-compat alias: a train stream over the FULL corpus (no held-out
+    splits — callers wanting splits use gpt_data_iterator)."""
+    return gpt_data_iterator(data_path, hp, seq_len, seed=seed,
+                             n_samples=n_samples, start_step=start_step,
+                             split="train", split_weights="1,0,0")

@@ -117,3 +117,39 @@ def convert_hf_llama(state_dict: Dict[str, Any], cfg: TransformerConfig) -> Dict
             lp["wkv"] = {"kernel": jnp.asarray(np.stack([k, v], axis=1))}
         params["layers"].append(lp)
     return params
+
+
+def export_hf_llama(params: Dict[str, Any], cfg: TransformerConfig) -> Dict[str, np.ndarray]:
+    """galvatron_tpu param tree -> HF LlamaForCausalLM state dict arrays —
+    exact inverse of convert_hf_llama (the analogue of the reference llama
+    exporter, tools/checkpoint_convert_g2h.py:11-110)."""
+    h, nh, nkv, hd = cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    a = lambda x: np.asarray(x, np.float32)
+    out: Dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": a(params["embed"]["wte"]),
+        "model.norm.weight": a(params["final_norm"]["scale"]),
+    }
+    if cfg.tie_embeddings:
+        out["lm_head.weight"] = a(params["embed"]["wte"])
+    else:
+        out["lm_head.weight"] = a(params["lm_head"]["kernel"]).T
+    for i, lp in enumerate(params["layers"]):
+        pre = "model.layers.%d." % i
+        if cfg.fused_qkv:
+            qkv = a(lp["wqkv"]["kernel"])  # (h, 3, nh, hd)
+            q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+        else:
+            q = a(lp["wq"]["kernel"])  # (h, nh, hd)
+            kv = a(lp["wkv"]["kernel"])  # (h, 2, nkv, hd)
+            k, v = kv[:, 0], kv[:, 1]
+        out[pre + "self_attn.q_proj.weight"] = q.reshape(h, nh * hd).T
+        out[pre + "self_attn.k_proj.weight"] = k.reshape(h, nkv * hd).T
+        out[pre + "self_attn.v_proj.weight"] = v.reshape(h, nkv * hd).T
+        out[pre + "self_attn.o_proj.weight"] = a(lp["wo"]["kernel"]).T
+        wi = a(lp["wi"]["kernel"])  # (h, 2, ffn): [gate, up]
+        out[pre + "mlp.gate_proj.weight"] = wi[:, 0].T
+        out[pre + "mlp.up_proj.weight"] = wi[:, 1].T
+        out[pre + "mlp.down_proj.weight"] = a(lp["wo_mlp"]["kernel"]).T
+        out[pre + "input_layernorm.weight"] = a(lp["ln1"]["scale"])
+        out[pre + "post_attention_layernorm.weight"] = a(lp["ln2"]["scale"])
+    return out

@@ -465,6 +465,54 @@ def convert_hf_swin(state_dict: Dict[str, Any], cfg: SwinConfig) -> Params:
     return params
 
 
+def export_hf_swin(params: Params, cfg: SwinConfig) -> Dict[str, np.ndarray]:
+    """galvatron_tpu param tree -> HF SwinForImageClassification state dict
+    arrays — exact inverse of convert_hf_swin (reference g2h analogue)."""
+    Ppat, C, E = cfg.patch_size, cfg.num_channels, cfg.embed_dim
+    a = lambda x: np.asarray(x, np.float32)
+    out: Dict[str, np.ndarray] = {
+        "swin.embeddings.patch_embeddings.projection.weight": a(
+            params["embed"]["patch"]["kernel"]
+        ).reshape(Ppat, Ppat, C, E).transpose(3, 2, 0, 1),
+        "swin.embeddings.patch_embeddings.projection.bias": a(params["embed"]["patch"]["bias"]),
+        "swin.embeddings.norm.weight": a(params["embed"]["norm"]["scale"]),
+        "swin.embeddings.norm.bias": a(params["embed"]["norm"]["bias"]),
+        "swin.layernorm.weight": a(params["final_norm"]["scale"]),
+        "swin.layernorm.bias": a(params["final_norm"]["bias"]),
+        "classifier.weight": a(params["head"]["kernel"]).T,
+        "classifier.bias": a(params["head"]["bias"]),
+    }
+    for i, bp in enumerate(params["blocks"]):
+        stage = cfg.stage_of_block(i)
+        d = i - int(np.sum(cfg.depths[:stage]))
+        c = cfg.stage_dim(stage)
+        nh = cfg.num_heads[stage]
+        hd = c // nh
+        pre = "swin.encoder.layers.%d.blocks.%d." % (stage, d)
+        qkv = a(bp["wqkv"]["kernel"])  # (c, 3, nh, hd)
+        qkv_b = a(bp["wqkv"]["bias"])  # (3, nh, hd)
+        for j, role in enumerate(("query", "key", "value")):
+            out[pre + "attention.self.%s.weight" % role] = qkv[:, j].reshape(c, nh * hd).T
+            out[pre + "attention.self.%s.bias" % role] = qkv_b[j].reshape(nh * hd)
+        out[pre + "attention.self.relative_position_bias_table"] = a(bp["rel_bias"])
+        out[pre + "attention.output.dense.weight"] = a(bp["wo"]["kernel"]).T
+        out[pre + "attention.output.dense.bias"] = a(bp["wo"]["bias"])
+        out[pre + "intermediate.dense.weight"] = a(bp["wi"]["kernel"]).T
+        out[pre + "intermediate.dense.bias"] = a(bp["wi"]["bias"])
+        out[pre + "output.dense.weight"] = a(bp["wo_mlp"]["kernel"]).T
+        out[pre + "output.dense.bias"] = a(bp["wo_mlp"]["bias"])
+        out[pre + "layernorm_before.weight"] = a(bp["ln1"]["scale"])
+        out[pre + "layernorm_before.bias"] = a(bp["ln1"]["bias"])
+        out[pre + "layernorm_after.weight"] = a(bp["ln2"]["scale"])
+        out[pre + "layernorm_after.bias"] = a(bp["ln2"]["bias"])
+    for s, mp in enumerate(params["merges"]):
+        pre = "swin.encoder.layers.%d.downsample." % s
+        out[pre + "norm.weight"] = a(mp["norm"]["scale"])
+        out[pre + "norm.bias"] = a(mp["norm"]["bias"])
+        out[pre + "reduction.weight"] = a(mp["reduction"]["kernel"]).T
+    return out
+
+
 # ================================================================ constructor
 def construct_swin_model(cfg: SwinConfig, hp: HybridParallelConfig, devices=None):
     from galvatron_tpu.parallel.mesh import build_mesh
@@ -569,6 +617,7 @@ def _register():
             default_size="swin-tiny",
             data_kind="vision",
             convert_from_hf=convert_hf_swin,
+            export_to_hf=export_hf_swin,
             config_from_hf=swin_config_from_hf,
             build=construct_swin_model,
             layer_configs_fn=_swin_layer_configs,

@@ -579,6 +579,64 @@ def _t5_profiler(cfg, model_name, args):
     return T5ModelProfiler(cfg, model_name, args)
 
 
+def export_hf_t5(params: Params, cfg: T5Config) -> Dict[str, np.ndarray]:
+    """galvatron_tpu param tree -> HF T5ForConditionalGeneration state dict
+    arrays — exact inverse of convert_hf_t5 (reference g2h analogue)."""
+    h, nh, hd = cfg.hidden_size, cfg.num_heads, cfg.head_dim
+    a = lambda x: np.asarray(x, np.float32)
+
+    def attn_out(out, prefix, ap):
+        for role in ("q", "k", "v"):
+            out[prefix + "%s.weight" % role] = a(
+                ap["w" + role]["kernel"]
+            ).reshape(h, nh * hd).T
+        out[prefix + "o.weight"] = a(ap["wo"]["kernel"]).T
+
+    def mlp_out(out, prefix, lp):
+        wi = a(lp["wi"]["kernel"])
+        if cfg.activation == "gated-gelu":
+            out[prefix + "wi_0.weight"] = wi[:, 0].T
+            out[prefix + "wi_1.weight"] = wi[:, 1].T
+        else:
+            out[prefix + "wi.weight"] = wi.T
+        out[prefix + "wo.weight"] = a(lp["wo_mlp"]["kernel"]).T
+
+    wte = a(params["embed"]["wte"])
+    out: Dict[str, np.ndarray] = {
+        "shared.weight": wte,
+        # HF materialises the tied encoder/decoder embedding copies
+        "encoder.embed_tokens.weight": wte,
+        "decoder.embed_tokens.weight": wte,
+        "encoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight": a(
+            params["enc_rel_bias"]
+        ),
+        "decoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight": a(
+            params["dec_rel_bias"]
+        ),
+        "encoder.final_layer_norm.weight": a(params["enc_norm"]["scale"]),
+        "decoder.final_layer_norm.weight": a(params["dec_norm"]["scale"]),
+    }
+    if cfg.tie_embeddings:
+        out["lm_head.weight"] = a(params["embed"]["wte"])
+    else:
+        out["lm_head.weight"] = a(params["lm_head"]["kernel"]).T
+    for i, lp in enumerate(params["enc_layers"]):
+        pre = "encoder.block.%d.layer." % i
+        out[pre + "0.layer_norm.weight"] = a(lp["ln1"]["scale"])
+        out[pre + "1.layer_norm.weight"] = a(lp["ln2"]["scale"])
+        attn_out(out, pre + "0.SelfAttention.", lp)
+        mlp_out(out, pre + "1.DenseReluDense.", lp)
+    for i, lp in enumerate(params["dec_layers"]):
+        pre = "decoder.block.%d.layer." % i
+        out[pre + "0.layer_norm.weight"] = a(lp["ln1"]["scale"])
+        out[pre + "1.layer_norm.weight"] = a(lp["ln_cross"]["scale"])
+        out[pre + "2.layer_norm.weight"] = a(lp["ln2"]["scale"])
+        attn_out(out, pre + "0.SelfAttention.", lp)
+        attn_out(out, pre + "1.EncDecAttention.", lp["cross"])
+        mlp_out(out, pre + "2.DenseReluDense.", lp)
+    return out
+
+
 def _register():
     from galvatron_tpu.models.registry import ModelFamily, register
 
@@ -590,6 +648,7 @@ def _register():
             default_size="t5-base",
             data_kind="seq2seq",
             convert_from_hf=convert_hf_t5,
+            export_to_hf=export_hf_t5,
             config_from_hf=t5_config_from_hf,
             build=construct_t5_model,
             layer_configs_fn=_t5_layer_configs,

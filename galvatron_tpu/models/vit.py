@@ -131,6 +131,44 @@ def convert_hf_vit(state_dict: Dict[str, Any], cfg: TransformerConfig) -> Dict[s
     return params
 
 
+def export_hf_vit(params: Dict[str, Any], cfg: TransformerConfig) -> Dict[str, np.ndarray]:
+    """galvatron_tpu param tree -> HF ViTForImageClassification state dict
+    arrays — exact inverse of convert_hf_vit (reference g2h analogue)."""
+    h, nh, hd, P, C = (cfg.hidden_size, cfg.num_heads, cfg.head_dim,
+                       cfg.patch_size, cfg.num_channels)
+    a = lambda x: np.asarray(x, np.float32)
+    out: Dict[str, np.ndarray] = {
+        "vit.embeddings.patch_embeddings.projection.weight": a(
+            params["embed"]["patch"]["kernel"]
+        ).reshape(P, P, C, h).transpose(3, 2, 0, 1),
+        "vit.embeddings.patch_embeddings.projection.bias": a(params["embed"]["patch"]["bias"]),
+        "vit.embeddings.position_embeddings": a(params["embed"]["wpe"])[None],
+        "vit.embeddings.cls_token": a(params["embed"]["cls_token"]).reshape(1, 1, h),
+        "vit.layernorm.weight": a(params["final_norm"]["scale"]),
+        "vit.layernorm.bias": a(params["final_norm"]["bias"]),
+        "classifier.weight": a(params["head"]["kernel"]).T,
+        "classifier.bias": a(params["head"]["bias"]),
+    }
+    for i, lp in enumerate(params["layers"]):
+        pre = "vit.encoder.layer.%d." % i
+        qkv = a(lp["wqkv"]["kernel"])  # (h, 3, nh, hd)
+        qkv_b = a(lp["wqkv"]["bias"])  # (3, nh, hd)
+        for j, role in enumerate(("query", "key", "value")):
+            out[pre + "attention.attention.%s.weight" % role] = qkv[:, j].reshape(h, nh * hd).T
+            out[pre + "attention.attention.%s.bias" % role] = qkv_b[j].reshape(nh * hd)
+        out[pre + "attention.output.dense.weight"] = a(lp["wo"]["kernel"]).T
+        out[pre + "attention.output.dense.bias"] = a(lp["wo"]["bias"])
+        out[pre + "intermediate.dense.weight"] = a(lp["wi"]["kernel"]).T
+        out[pre + "intermediate.dense.bias"] = a(lp["wi"]["bias"])
+        out[pre + "output.dense.weight"] = a(lp["wo_mlp"]["kernel"]).T
+        out[pre + "output.dense.bias"] = a(lp["wo_mlp"]["bias"])
+        out[pre + "layernorm_before.weight"] = a(lp["ln1"]["scale"])
+        out[pre + "layernorm_before.bias"] = a(lp["ln1"]["bias"])
+        out[pre + "layernorm_after.weight"] = a(lp["ln2"]["scale"])
+        out[pre + "layernorm_after.bias"] = a(lp["ln2"]["bias"])
+    return out
+
+
 def _register():
     from galvatron_tpu.models.registry import ModelFamily, register
 
@@ -142,6 +180,7 @@ def _register():
             default_size="vit-base",
             data_kind="vision",
             convert_from_hf=convert_hf_vit,
+            export_to_hf=export_hf_vit,
             config_from_hf=vit_config_from_hf,
         )
     )

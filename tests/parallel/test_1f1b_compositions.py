@@ -159,3 +159,18 @@ def test_composition_matrix(devices8, name, stage, kw):
     compiled, params, opt_state = _compile_step(m, batch)
     params, opt_state, metrics = compiled(params, opt_state, batch)
     assert np.isfinite(float(metrics["loss"]))
+
+
+def test_gate_matrix_mirrors_pytest(devices8):
+    """Every config the external dryrun_multichip gate cycles must be a
+    pytest first (round-2 postmortem rule). Runs the gate's own builders."""
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+    try:
+        import __graft_entry__ as gate
+    finally:
+        sys.path.pop(0)
+    for name, run in gate.GATE_CONFIGS.items():
+        loss = run(devices8)
+        assert np.isfinite(loss), "gate config %s produced loss %r" % (name, loss)

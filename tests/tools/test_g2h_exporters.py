@@ -1,0 +1,178 @@
+"""Native -> HF export round trips for llama/vit/t5/swin (VERDICT r3 item 6;
+reference tools/checkpoint_convert_g2h.py:11-110 covers llama — this build
+exports every family). Pattern per test_bert_roundtrip_export: convert the HF
+state dict to the native tree, export it back, and compare tensors — tensor
+equality implies logit parity (the HF-side forward is unchanged)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+pytestmark = [pytest.mark.utils]
+
+
+# HF state-dict entries that are derived buffers, not parameters — an
+# exporter is complete without them
+_NON_PARAM = ("position_ids", "relative_position_index", "masked_bias",
+              "inv_freq", ".attn.bias")
+
+
+def _assert_roundtrip(back, sd):
+    bogus = [k for k in back if k not in sd]
+    assert not bogus, "exported keys absent from HF state dict: %s" % bogus[:5]
+    # completeness: every HF PARAMETER must be exported (a silently dropped
+    # key would round-trip green while producing wrong HF logits)
+    dropped = [
+        k for k in sd
+        if k not in back and not any(tag in k for tag in _NON_PARAM)
+    ]
+    assert not dropped, "HF parameters missing from the export: %s" % dropped[:5]
+    for k, v in back.items():
+        np.testing.assert_allclose(v, sd[k].numpy(), atol=1e-6, err_msg=k)
+
+
+def test_llama_roundtrip_export():
+    from galvatron_tpu.models.llama import (
+        convert_hf_llama,
+        export_hf_llama,
+        llama_config_from_hf,
+    )
+
+    hf_cfg = transformers.LlamaConfig(
+        hidden_size=64, intermediate_size=176, num_attention_heads=4,
+        num_hidden_layers=2, vocab_size=128, max_position_embeddings=64,
+    )
+    torch.manual_seed(0)
+    hf = transformers.LlamaForCausalLM(hf_cfg)
+    cfg = llama_config_from_hf(hf_cfg, compute_dtype=jnp.float32)
+    params = convert_hf_llama(hf.state_dict(), cfg)
+    _assert_roundtrip(export_hf_llama(params, cfg), hf.state_dict())
+
+
+def test_llama_gqa_roundtrip_export():
+    """GQA (num_kv_heads < num_heads) exercises the unfused wq/wkv layout."""
+    from galvatron_tpu.models.llama import (
+        convert_hf_llama,
+        export_hf_llama,
+        llama_config_from_hf,
+    )
+
+    hf_cfg = transformers.LlamaConfig(
+        hidden_size=64, intermediate_size=176, num_attention_heads=4,
+        num_key_value_heads=2, num_hidden_layers=2, vocab_size=128,
+        max_position_embeddings=64,
+    )
+    torch.manual_seed(1)
+    hf = transformers.LlamaForCausalLM(hf_cfg)
+    cfg = llama_config_from_hf(hf_cfg, compute_dtype=jnp.float32)
+    assert not cfg.fused_qkv
+    params = convert_hf_llama(hf.state_dict(), cfg)
+    _assert_roundtrip(export_hf_llama(params, cfg), hf.state_dict())
+
+
+def test_llama_g2h_cli_roundtrip(tmp_path):
+    """Full CLI path: h2g writes orbax, g2h reads it back to an HF .bin whose
+    tensors match the original (VERDICT done-criterion: the exported
+    checkpoint reproduces HF logits — same weights, same HF forward)."""
+    from galvatron_tpu.tools.convert_checkpoint import main as convert_main
+
+    hf_cfg = transformers.LlamaConfig(
+        hidden_size=64, intermediate_size=176, num_attention_heads=4,
+        num_hidden_layers=2, vocab_size=128, max_position_embeddings=64,
+    )
+    torch.manual_seed(2)
+    hf = transformers.LlamaForCausalLM(hf_cfg)
+    hf_dir = tmp_path / "hf"
+    hf.save_pretrained(hf_dir, safe_serialization=False)
+
+    ckpt = str(tmp_path / "ckpt")
+    convert_main(["h2g", "--model_type", "llama", "--hf_path", str(hf_dir),
+                  "--output_dir", ckpt])
+    out_bin = str(tmp_path / "back.bin")
+    convert_main(["g2h", "--model_type", "llama", "--hf_config_path", str(hf_dir),
+                  "--checkpoint_dir", ckpt, "--output_path", out_bin])
+    back = torch.load(out_bin, weights_only=True)
+    sd = hf.state_dict()
+    for k, v in back.items():
+        if k in sd:
+            np.testing.assert_allclose(v.numpy(), sd[k].numpy(), atol=1e-6, err_msg=k)
+
+
+def test_vit_roundtrip_export():
+    from galvatron_tpu.models.vit import (
+        convert_hf_vit,
+        export_hf_vit,
+        vit_config_from_hf,
+    )
+
+    hf_cfg = transformers.ViTConfig(
+        hidden_size=32, num_attention_heads=2, num_hidden_layers=2,
+        intermediate_size=64, image_size=32, patch_size=8,
+    )
+    torch.manual_seed(3)
+    hf = transformers.ViTForImageClassification(hf_cfg)
+    cfg = vit_config_from_hf(hf_cfg, num_classes=hf_cfg.num_labels,
+                             compute_dtype=jnp.float32)
+    params = convert_hf_vit(hf.state_dict(), cfg)
+    _assert_roundtrip(export_hf_vit(params, cfg), hf.state_dict())
+
+
+def test_t5_roundtrip_export():
+    from galvatron_tpu.models.t5 import (
+        convert_hf_t5,
+        export_hf_t5,
+        t5_config_from_hf,
+    )
+
+    hf_cfg = transformers.T5Config(
+        d_model=32, d_kv=16, d_ff=64, num_layers=2, num_decoder_layers=2,
+        num_heads=2, vocab_size=128, feed_forward_proj="gated-gelu",
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(4)
+    hf = transformers.T5ForConditionalGeneration(hf_cfg)
+    cfg = t5_config_from_hf(hf_cfg, compute_dtype=jnp.float32)
+    params = convert_hf_t5(hf.state_dict(), cfg)
+    _assert_roundtrip(export_hf_t5(params, cfg), hf.state_dict())
+
+
+def test_t5_relu_tied_roundtrip_export():
+    """The relu (ungated) MLP layout and tied lm_head take different branches."""
+    from galvatron_tpu.models.t5 import (
+        convert_hf_t5,
+        export_hf_t5,
+        t5_config_from_hf,
+    )
+
+    hf_cfg = transformers.T5Config(
+        d_model=32, d_kv=16, d_ff=64, num_layers=2, num_decoder_layers=2,
+        num_heads=2, vocab_size=128, feed_forward_proj="relu",
+        tie_word_embeddings=True,
+    )
+    torch.manual_seed(5)
+    hf = transformers.T5ForConditionalGeneration(hf_cfg)
+    cfg = t5_config_from_hf(hf_cfg, compute_dtype=jnp.float32)
+    params = convert_hf_t5(hf.state_dict(), cfg)
+    _assert_roundtrip(export_hf_t5(params, cfg), hf.state_dict())
+
+
+def test_swin_roundtrip_export():
+    from galvatron_tpu.models.swin import (
+        convert_hf_swin,
+        export_hf_swin,
+        swin_config_from_hf,
+    )
+
+    hf_cfg = transformers.SwinConfig(
+        image_size=32, patch_size=4, embed_dim=16, depths=(2, 2),
+        num_heads=(2, 4), window_size=4, mlp_ratio=2.0,
+    )
+    torch.manual_seed(6)
+    hf = transformers.SwinForImageClassification(hf_cfg)
+    cfg = swin_config_from_hf(hf_cfg, compute_dtype=jnp.float32)
+    params = convert_hf_swin(hf.state_dict(), cfg)
+    _assert_roundtrip(export_hf_swin(params, cfg), hf.state_dict())
