@@ -125,6 +125,14 @@ def layer_fwd_metric():
 
 
 # ------------------------------------------------- LLaMA-7B-layer train step
+# steps executed back-to-back inside one jitted scan per timed call: the
+# ~70 ms axon-tunnel dispatch latency amortises away and the measurement is
+# the DEVICE step time, as in real training where dispatch runs ahead of the
+# device (same differencing rationale as layer_fwd_metric; round 3 measured
+# single synced calls and under-reported MFU 0.38 vs the true ~0.6)
+STEPS_PER_CALL = 1 if SMOKE else 8
+
+
 def train_step_metric():
     import optax
 
@@ -150,28 +158,92 @@ def train_step_metric():
             y = M.layer_forward(lp, y, positions, cfg)
         return jnp.mean(y.astype(jnp.float32) ** 2)
 
-    # donate params + opt state: without donation the updated copies double
-    # the resident model states and OOM the chip
-    @partial(jax.jit, donate_argnums=(0, 1))
-    def step(layers, opt_state, x):
+    def one_step(carry, _):
+        layers, opt_state = carry
         loss, grads = jax.value_and_grad(loss_fn)(layers, x)
         updates, opt_state = tx.update(grads, opt_state, layers)
         layers = optax.apply_updates(layers, updates)
-        return layers, opt_state, loss
+        return (layers, opt_state), loss
 
+    # donate params + opt state: without donation the updated copies double
+    # the resident model states and OOM the chip
+    @partial(jax.jit, donate_argnums=(0,))
+    def run_steps(carry):
+        carry, losses = jax.lax.scan(one_step, carry, None, length=STEPS_PER_CALL)
+        return carry, losses[-1]
+
+    carry = (layers, opt_state)
     # warmup (compile + first run)
-    layers, opt_state, loss = step(layers, opt_state, x)
+    carry, loss = run_steps(carry)
     _sync(loss)
     rounds = []
     for _ in range(ROUNDS):
         times = []
         for _ in range(max(ITERS // 2, 2)):
             t0 = time.perf_counter()
-            layers, opt_state, loss = step(layers, opt_state, x)
+            carry, loss = run_steps(carry)
             _sync(loss)
             times.append(time.perf_counter() - t0)
-        rounds.append(float(np.median(times)))
+        rounds.append(float(np.median(times)) / STEPS_PER_CALL)
     step_s = float(np.min(rounds))
+    layers = carry[0]
+
+    # component breakdown (VERDICT r3: record where the step time goes);
+    # guarded — a tunnel compile failure OR HANG must not lose the headline
+    # metric (the axon remote-compile endpoint has been observed to wedge)
+    breakdown = {}
+    import signal
+
+    def _alarm(signum, frame):
+        raise TimeoutError("breakdown compile/run exceeded budget")
+
+    old_handler = signal.signal(signal.SIGALRM, _alarm)
+    signal.alarm(180)
+    try:
+        K = STEPS_PER_CALL
+
+        @jax.jit
+        def fwd_k(xx):
+            def body(c, _):
+                y = c
+                for lp in layers:
+                    y = M.layer_forward(lp, y, positions, cfg)
+                return 0.5 * c + 0.5 * y, ()
+            out, _ = jax.lax.scan(body, xx, None, length=K)
+            return out
+
+        grads = jax.tree.map(jnp.zeros_like, layers)
+
+        @jax.jit
+        def adam_k(carry):
+            def body(c, _):
+                ls, st = c
+                updates, st = tx.update(grads, st, ls)
+                return (optax.apply_updates(ls, updates), st), ()
+            out, _ = jax.lax.scan(body, carry, None, length=K)
+            return out
+
+        def _time(fn, *a):
+            _sync(fn(*a))
+            ts = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                _sync(fn(*a))
+                ts.append(time.perf_counter() - t0)
+            return float(np.min(ts)) / K
+
+        t_fwd = _time(fwd_k, x)
+        t_adam = _time(adam_k, (layers, opt_state))
+        breakdown = {
+            "fwd_ms": round(t_fwd * 1e3, 2),
+            "adam_ms": round(t_adam * 1e3, 2),
+            "bwd_plus_overhead_ms": round((step_s - t_fwd - t_adam) * 1e3, 2),
+        }
+    except Exception as e:  # pragma: no cover - tunnel flakiness
+        breakdown = {"error": str(e)[:120]}
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old_handler)
 
     tokens = L7B_BATCH * L7B_SEQ
     n_params = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(layers))
@@ -184,10 +256,12 @@ def train_step_metric():
     return {
         "config": "llama7b_layer_stack%d_seq%d_bf16_adam" % (L7B_LAYERS, L7B_SEQ),
         "step_ms": round(step_s * 1e3, 3),
+        "steps_per_call": STEPS_PER_CALL,
         "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
         "mfu": round(mfu, 4) if mfu is not None else None,
         "device_kind": kind,
         "params": n_params,
+        "breakdown": breakdown,
     }
 
 

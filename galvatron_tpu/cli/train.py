@@ -52,19 +52,33 @@ def build_data_iterator(args, fam, cfg, hp, start_step: int = 0,
     # synthetic streams have no documents to split: derive a disjoint,
     # deterministic stream per split from the seed
     split_seed = args.seed + {"train": 0, "valid": 7919, "test": 15838}.get(split, 0)
+    split_weights = getattr(args, "split", "969,30,1")
     if args.data_path:
-        if fam.data_kind != "lm":
-            raise ValueError(
-                "--data_path provides a token LM stream; family %r (data_kind=%s) "
-                "needs its own input pipeline (synthetic fallback runs without "
-                "--data_path)" % (fam.name, fam.data_kind)
+        if fam.data_kind == "seq2seq":
+            # span corruption over the indexed corpus (reference
+            # T5MaskedWordPieceDataset, models/T5/dataloader.py:152-200)
+            from galvatron_tpu.data.dataset import t5_data_iterator
+
+            return t5_data_iterator(
+                args.data_path, hp, enc_seq_len=cfg.max_seq_len,
+                dec_seq_len=cfg.max_seq_len, seed=args.seed,
+                start_step=start_step, split=split,
+                split_weights=split_weights, vocab_size=cfg.vocab_size,
+            )
+        if fam.data_kind == "vision":
+            from galvatron_tpu.data.dataset import vision_data_iterator
+
+            return vision_data_iterator(
+                args.data_path, hp, image_size=cfg.image_size,
+                num_channels=cfg.num_channels, seed=args.seed,
+                start_step=start_step, split=split,
+                split_weights=split_weights,
             )
         from galvatron_tpu.data.dataset import gpt_data_iterator
 
         return gpt_data_iterator(
             args.data_path, hp, seq_len=cfg.max_seq_len, seed=args.seed,
-            start_step=start_step, split=split,
-            split_weights=getattr(args, "split", "969,30,1"),
+            start_step=start_step, split=split, split_weights=split_weights,
         )
     if fam.data_kind == "vision":
         from galvatron_tpu.runtime.dataloader import get_vision_train_iterator

@@ -55,4 +55,35 @@ int64_t build_sample_idx(const int32_t* doc_lens,
     return sample;
 }
 
+// Greedy corpus-blend schedule (reference helpers.cpp
+// build_blending_indices, consumed by BlendedMegatronDatasetBuilder):
+// sample i draws from the dataset whose running count lags its normalised
+// weight most, so every stream prefix tracks the requested proportions.
+//
+// weights:    normalised blend weights                [n_datasets]
+// ds_index:   out, dataset id per sample              [n_samples]
+// ds_sample:  out, within-dataset sample id           [n_samples]
+void build_blending_indices(const double* weights,
+                            int64_t n_datasets,
+                            int64_t n_samples,
+                            int32_t* ds_index,
+                            int64_t* ds_sample) {
+    int64_t* counts = new int64_t[n_datasets]();
+    for (int64_t i = 0; i < n_samples; ++i) {
+        int64_t best = 0;
+        double best_err = 0.0;
+        for (int64_t j = 0; j < n_datasets; ++j) {
+            double err = (double)(counts[j] + 1) / ((double)(i + 1) * weights[j]);
+            if (j == 0 || err < best_err) {
+                best = j;
+                best_err = err;
+            }
+        }
+        ds_index[i] = (int32_t)best;
+        ds_sample[i] = counts[best];
+        ++counts[best];
+    }
+    delete[] counts;
+}
+
 }  // extern "C"

@@ -62,6 +62,15 @@ def _load_helpers():
         ctypes.c_int64,
         ctypes.POINTER(ctypes.c_int64),
     ]
+    if hasattr(lib, "build_blending_indices"):
+        lib.build_blending_indices.restype = None
+        lib.build_blending_indices.argtypes = [
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
     _lib = lib
     return _lib
 
@@ -236,14 +245,22 @@ def gpt_data_iterator(
 ) -> Iterator[Dict[str, jnp.ndarray]]:
     """Deterministic batch stream over one split of the indexed dataset
     (reference core/runtime/dataloader.py:4-20 builds all three splits).
-    Batch content is a pure function of the step index, so resume passes
-    `start_step` (O(1) skip); the split ranges are pure functions of the
-    corpus + weights, so resume sees the same split."""
-    indexed = IndexedDataset(data_path)
-    docs = split_doc_ids(indexed.n_docs, split_weights)[split]
-    ds = GPTDataset(
-        indexed, seq_len, n_samples or 1_000_000, seed=seed, documents=docs,
-    )
+    `data_path` may be a single prefix or a Megatron-style blend
+    "W1 PREFIX1 W2 PREFIX2 ..." (BlendedMegatronDatasetBuilder). Batch
+    content is a pure function of the step index, so resume passes
+    `start_step` (O(1) skip); split ranges and the blend schedule are pure
+    functions of the corpora + weights, so resume sees the same streams."""
+    weights, prefixes = parse_blend(data_path)
+    total = n_samples or 1_000_000
+    per_corpus = []
+    for k, prefix in enumerate(prefixes):
+        indexed = IndexedDataset(prefix)
+        docs = split_doc_ids(indexed.n_docs, split_weights)[split]
+        per_corpus.append(GPTDataset(
+            indexed, seq_len, total, seed=seed + k, documents=docs,
+        ))
+    ds = (per_corpus[0] if len(per_corpus) == 1
+          else BlendedGPTDataset(per_corpus, weights, total))
     step = start_step
     while True:
         rows = [ds[step * hp.global_bsz + b] for b in range(hp.global_bsz)]
@@ -259,3 +276,231 @@ def gpt_train_iterator(data_path, hp, seq_len, seed=1234, n_samples=None,
     return gpt_data_iterator(data_path, hp, seq_len, seed=seed,
                              n_samples=n_samples, start_step=start_step,
                              split="train", split_weights="1,0,0")
+
+
+# ---------------------------------------------------------- corpus blending
+def build_blending_indices(weights: Sequence[float], n_samples: int):
+    """Greedy blend schedule (reference helpers.cpp build_blending_indices via
+    BlendedMegatronDatasetBuilder, models/gpt_hf/dataloader.py:7-8): sample i
+    draws from the dataset whose running count lags its weight most, so every
+    prefix of the stream tracks the requested proportions. Deterministic —
+    a pure function of (weights, n_samples). Returns (dataset_index,
+    dataset_sample_index) int arrays."""
+    w = np.asarray(weights, np.float64)
+    if (w <= 0).any():
+        raise ValueError("blend weights must be positive, got %r" % (list(weights),))
+    w = np.ascontiguousarray(w / w.sum())
+    ds_index = np.zeros(n_samples, np.int32)
+    ds_sample = np.zeros(n_samples, np.int64)
+    lib = _load_helpers()
+    if lib is not None and hasattr(lib, "build_blending_indices"):
+        lib.build_blending_indices(
+            w.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            len(w), n_samples,
+            ds_index.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            ds_sample.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        )
+        return ds_index, ds_sample
+    counts = np.zeros(len(w), np.int64)
+    for i in range(n_samples):
+        # error of adding one sample to each dataset; pick the most lagging
+        j = int(np.argmin((counts + 1) / ((i + 1) * w)))
+        ds_index[i] = j
+        ds_sample[i] = counts[j]
+        counts[j] += 1
+    return ds_index, ds_sample
+
+
+def parse_blend(data_path: str):
+    """Megatron --data-path blend syntax: "W1 PREFIX1 W2 PREFIX2 ..." (or a
+    single prefix). Returns (weights, prefixes)."""
+    parts = data_path.split()
+    if len(parts) <= 1:
+        return [1.0], [data_path.strip() or data_path]
+    if len(parts) % 2 != 0:
+        raise ValueError(
+            "blended --data_path must alternate WEIGHT PREFIX pairs, got %r" % data_path
+        )
+    weights = [float(parts[i]) for i in range(0, len(parts), 2)]
+    prefixes = [parts[i] for i in range(1, len(parts), 2)]
+    return weights, prefixes
+
+
+class BlendedGPTDataset:
+    """Weighted blend of per-corpus GPTDatasets (each already restricted to
+    the requested split)."""
+
+    def __init__(self, datasets: List[GPTDataset], weights: Sequence[float],
+                 n_samples: int):
+        if len(datasets) != len(weights):
+            raise ValueError("need one weight per dataset")
+        self.datasets = datasets
+        self.ds_index, self.ds_sample = build_blending_indices(weights, n_samples)
+        self.n_samples = n_samples
+
+    def __len__(self):
+        return self.n_samples
+
+    def __getitem__(self, i: int) -> np.ndarray:
+        i = i % self.n_samples
+        return self.datasets[int(self.ds_index[i])][int(self.ds_sample[i])]
+
+
+# ------------------------------------------------------- T5 span corruption
+def t5_span_corrupt(tokens: np.ndarray, rng: np.random.RandomState, *,
+                    vocab_size: int, noise_density: float = 0.15,
+                    mean_span_len: float = 3.0, n_sentinels: int = 100):
+    """T5 span-corruption of one token window (the reference's
+    T5MaskedWordPieceDataset objective, models/T5/dataloader.py:152-200,
+    re-derived from the T5 paper's denoising recipe rather than the megatron
+    wordpiece masker): contiguous spans covering ~noise_density of the window
+    are each replaced by ONE sentinel id in the encoder stream; the decoder
+    target is [sentinel_i, span_i...] for every span, closed by a final
+    sentinel. Sentinels count down from vocab_size-1 (HF T5 extra_ids).
+
+    Returns (enc_tokens, dec_target) as int32 arrays (variable length)."""
+    L = len(tokens)
+    n_noise = max(int(round(L * noise_density)), 1)
+    n_spans = max(int(round(n_noise / mean_span_len)), 1)
+    # random span lengths summing to n_noise (multinomial split)
+    cuts = np.sort(rng.choice(np.arange(1, n_noise), size=n_spans - 1,
+                              replace=False)) if n_noise > n_spans else np.arange(1, n_spans)
+    span_lens = np.diff(np.concatenate([[0], cuts, [n_noise]]))
+    span_lens = span_lens[span_lens > 0]
+    # random span starts over the non-noise gaps
+    n_gap = L - int(span_lens.sum())
+    starts_gap = np.sort(rng.choice(np.arange(n_gap + 1), size=len(span_lens),
+                                    replace=False))
+    enc_parts, dec_parts = [], []
+    pos = 0
+    gap_consumed = 0
+    for i, (g, sl) in enumerate(zip(starts_gap, span_lens)):
+        keep = g - gap_consumed
+        sentinel = vocab_size - 1 - (i % n_sentinels)
+        enc_parts.append(tokens[pos : pos + keep])
+        enc_parts.append(np.asarray([sentinel], np.int32))
+        dec_parts.append(np.asarray([sentinel], np.int32))
+        dec_parts.append(tokens[pos + keep : pos + keep + sl])
+        pos += keep + sl
+        gap_consumed = g
+    enc_parts.append(tokens[pos:])
+    dec_parts.append(np.asarray([vocab_size - 1 - (len(span_lens) % n_sentinels)], np.int32))
+    return (np.concatenate(enc_parts).astype(np.int32),
+            np.concatenate(dec_parts).astype(np.int32))
+
+
+def t5_data_iterator(
+    data_path: str,
+    hp: HybridParallelConfig,
+    enc_seq_len: int,
+    dec_seq_len: int,
+    seed: int = 1234,
+    n_samples: Optional[int] = None,
+    start_step: int = 0,
+    split: str = "train",
+    split_weights: str = "969,30,1",
+    vocab_size: int = 32128,
+    noise_density: float = 0.15,
+    mean_span_len: float = 3.0,
+) -> Iterator[Dict[str, jnp.ndarray]]:
+    """Span-corruption batch stream over one split of an indexed corpus.
+    Emits the t5 batch contract (tokens/attn_mask/dec_tokens/labels/
+    loss_mask) at STATIC shapes (enc_seq_len, dec_seq_len) — truncate/pad,
+    jit sees one shape. Deterministic per (corpus, weights, seed, step)."""
+    indexed = IndexedDataset(data_path)
+    docs = split_doc_ids(indexed.n_docs, split_weights)[split]
+    ds = GPTDataset(indexed, enc_seq_len, n_samples or 1_000_000, seed=seed,
+                    documents=docs)
+    step = start_step
+    while True:
+        enc = np.zeros((hp.global_bsz, enc_seq_len), np.int32)
+        attn = np.zeros((hp.global_bsz, enc_seq_len), np.float32)
+        dec_in = np.zeros((hp.global_bsz, dec_seq_len), np.int32)
+        labels = np.zeros((hp.global_bsz, dec_seq_len), np.int32)
+        lmask = np.zeros((hp.global_bsz, dec_seq_len), np.float32)
+        for b in range(hp.global_bsz):
+            i = step * hp.global_bsz + b
+            window = ds[i][:enc_seq_len]
+            rng = np.random.RandomState((seed * 1_000_003 + i) % (2**31 - 1))
+            e, d = t5_span_corrupt(
+                window, rng, vocab_size=vocab_size,
+                noise_density=noise_density, mean_span_len=mean_span_len,
+            )
+            e, d = e[:enc_seq_len], d[:dec_seq_len]
+            enc[b, : len(e)] = e
+            attn[b, : len(e)] = 1.0
+            # teacher forcing: decoder input is the target shifted right
+            # behind the pad/start id 0 (HF T5 _shift_right)
+            dec_in[b, 1 : len(d)] = d[: len(d) - 1]
+            labels[b, : len(d)] = d
+            lmask[b, : len(d)] = 1.0
+        yield {
+            "tokens": jnp.asarray(enc),
+            "attn_mask": jnp.asarray(attn),
+            "dec_tokens": jnp.asarray(dec_in),
+            "labels": jnp.asarray(labels),
+            "loss_mask": jnp.asarray(lmask),
+        }
+        step += 1
+
+
+# ------------------------------------------------------------- vision shards
+def write_vision_dataset(path: str, images: np.ndarray, labels: np.ndarray) -> None:
+    """Write <path>.images.npy + <path>.labels.npy shards (uint8 or float32
+    NHWC images)."""
+    if len(images) != len(labels):
+        raise ValueError("images/labels length mismatch: %d vs %d" % (len(images), len(labels)))
+    np.save(path + ".images.npy", images)
+    np.save(path + ".labels.npy", np.asarray(labels, np.int32))
+
+
+def vision_data_iterator(
+    data_path: str,
+    hp: HybridParallelConfig,
+    image_size: int,
+    num_channels: int,
+    seed: int = 1234,
+    start_step: int = 0,
+    split: str = "train",
+    split_weights: str = "969,30,1",
+) -> Iterator[Dict[str, jnp.ndarray]]:
+    """Batch stream over .images.npy/.labels.npy shards (the vision analogue
+    of the indexed LM corpus; the reference wires megatron-style datasets for
+    swin/vit but trains on largely random pixels). Samples are memmapped;
+    sample order is a deterministic per-epoch permutation of the split."""
+    img_path, lab_path = data_path + ".images.npy", data_path + ".labels.npy"
+    if not os.path.exists(img_path) or not os.path.exists(lab_path):
+        raise FileNotFoundError(
+            "vision dataset %r needs %s and %s (write_vision_dataset builds them)"
+            % (data_path, img_path, lab_path)
+        )
+    images = np.load(img_path, mmap_mode="r")
+    labels = np.load(lab_path)
+    if images.shape[1] != image_size or images.shape[3] != num_channels:
+        raise ValueError(
+            "dataset images are %s; model expects (%d, %d, %d)"
+            % (images.shape[1:], image_size, image_size, num_channels)
+        )
+    ids = split_doc_ids(len(images), split_weights)[split]
+    if len(ids) == 0:
+        raise ValueError("empty %s split over %d samples" % (split, len(images)))
+    n = len(ids)
+    step = start_step
+    cur_epoch, perm = -1, None
+    while True:
+        batch_ids = []
+        for b in range(hp.global_bsz):
+            i = step * hp.global_bsz + b
+            epoch, off = divmod(i, n)
+            if epoch != cur_epoch:  # pure function of epoch: resume-safe
+                perm = np.random.RandomState(seed + epoch).permutation(n)
+                cur_epoch = epoch
+            batch_ids.append(ids[perm[off]])
+        px = np.stack([images[int(j)] for j in batch_ids])
+        if px.dtype == np.uint8:
+            px = px.astype(np.float32) / 255.0
+        yield {
+            "pixels": jnp.asarray(px.astype(np.float32)),
+            "labels": jnp.asarray(labels[np.asarray(batch_ids)].astype(np.int32)),
+        }
+        step += 1

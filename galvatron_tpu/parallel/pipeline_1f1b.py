@@ -47,6 +47,7 @@ the ``pp`` mesh axis and *auto* (GSPMD) over the within-stage axes:
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Any, Dict, List, NamedTuple
 
@@ -117,6 +118,25 @@ class Schedule(NamedTuple):
     emb_mb: np.ndarray  # (T,) microbatch whose embedding backward runs
     emb_valid: np.ndarray
     inject_mb: np.ndarray  # (T,) microbatch embedded for stage-0 injection
+
+
+def use_masked_path(has_cp: bool = False) -> bool:
+    """Mask-vs-branch path selection for the 1F1B engines (shared by the
+    enc-dec and swin variants). Default: CPU masks (divergent branch
+    collectives deadlock the single-process mesh), TPU branches (collectives
+    match statically per replica group). cp>1 always masks — the ring's
+    collective-permutes need every participant every tick on any backend.
+    GALVATRON_1F1B_PATH=branch|masked overrides the backend default — used
+    by the AOT tests that compile the TPU branch path for an abstract
+    topology from a CPU host (tests/parallel/test_branch_path_aot.py)."""
+    if has_cp:
+        return True
+    force = os.environ.get("GALVATRON_1F1B_PATH", "")
+    if force == "branch":
+        return False
+    if force == "masked":
+        return True
+    return jax.default_backend() == "cpu"
 
 
 def build_schedule(pp: int, chunks: int) -> Schedule:
@@ -299,7 +319,7 @@ def make_loss_and_grad(cfg, hp: HybridParallelConfig, mesh: Mesh):
     # participant every tick on any backend, so cp>1 forces the masked path
     # (validate_1f1b_config already required stage-uniform strategies).
     has_cp = any(s.cp > 1 for s in hp.layers)
-    mask_not_branch = jax.default_backend() == "cpu" or has_cp
+    mask_not_branch = use_masked_path(has_cp)
 
     # ------------------------------------------------------- vocab fwd pieces
     def embed_fwd(vparams, inputs, positions, token_types):
@@ -512,7 +532,17 @@ def make_loss_and_grad(cfg, hp: HybridParallelConfig, mesh: Mesh):
                 if mask_not_branch:
                     y = run_fwd(x_f) * xt["fwd_v"][stage].astype(act_dtype)
                 else:
-                    y = lax.cond(xt["fwd_v"][stage], run_fwd, jnp.zeros_like, x_f)
+                    # both branches pin the SAME exit sharding: the HLO
+                    # verifier rejects conditionals whose branches disagree
+                    # (caught by the AOT branch-path compile test — the bare
+                    # zeros branch lowered replicated vs the live branch's
+                    # mb_spec)
+                    y = lax.cond(
+                        xt["fwd_v"][stage],
+                        lambda x: S.constrain(run_fwd(x), mesh, mb_spec),
+                        lambda x: S.constrain(jnp.zeros_like(x), mesh, mb_spec),
+                        x_f,
+                    )
 
                 g_in = jnp.where(stage == pp - 1, dy, g_arr)
 
@@ -547,7 +577,14 @@ def make_loss_and_grad(cfg, hp: HybridParallelConfig, mesh: Mesh):
                     return dps_, S.constrain(dx_, mesh, mb_spec)
 
                 def zero_bwd(g):
-                    return jax.tree.map(jnp.zeros_like, local), jnp.zeros_like(x_b)
+                    # mirror run_bwd's exit pins exactly (see fwd cond note)
+                    zps = jax.tree.map(
+                        lambda a: S.constrain(
+                            jnp.zeros_like(a), mesh, S.replicated_spec(a.ndim)
+                        ),
+                        local,
+                    )
+                    return zps, S.constrain(jnp.zeros_like(x_b), mesh, mb_spec)
 
                 if mask_not_branch:
                     # masked cotangent -> exactly-zero grads for invalid slots

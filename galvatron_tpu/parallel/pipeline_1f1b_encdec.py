@@ -51,7 +51,7 @@ from jax.sharding import PartitionSpec as P
 from galvatron_tpu.config.strategy import HybridParallelConfig
 from galvatron_tpu.parallel import spec as S
 from galvatron_tpu.parallel.mesh import PP_AXIS, layer_axes, vocab_axes
-from galvatron_tpu.parallel.pipeline_1f1b import build_schedule
+from galvatron_tpu.parallel.pipeline_1f1b import build_schedule, use_masked_path
 
 Params = Dict[str, Any]
 
@@ -188,7 +188,7 @@ def make_encdec_loss_and_grad(cfg, hp: HybridParallelConfig, mesh):
     # encoder and decoder bodies always differ, so the lax.switch can never
     # collapse to a single body the way the generic engine's does
     uniform_stages = False
-    mask_not_branch = jax.default_backend() == "cpu"
+    mask_not_branch = use_masked_path()
 
     # ------------------------------------------------- per-stage forward body
     def stage_body(s: int, Sq: int):

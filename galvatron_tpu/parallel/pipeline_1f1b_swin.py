@@ -40,7 +40,7 @@ from jax.sharding import PartitionSpec as P
 from galvatron_tpu.config.strategy import HybridParallelConfig
 from galvatron_tpu.parallel import spec as S
 from galvatron_tpu.parallel.mesh import PP_AXIS, layer_axes, vocab_axes
-from galvatron_tpu.parallel.pipeline_1f1b import build_schedule
+from galvatron_tpu.parallel.pipeline_1f1b import build_schedule, use_masked_path
 
 Params = Dict[str, Any]
 
@@ -224,7 +224,7 @@ def make_swin_loss_and_grad(cfg, hp: HybridParallelConfig, mesh):
     N = L0 * C0  # flat channel width (largest activation; halves per merge)
     ch_spec = P(S._ax(vax.batch_axes), None)
 
-    mask_not_branch = jax.default_backend() == "cpu"
+    mask_not_branch = use_masked_path()
 
     # ------------------------------------------------- per-stage forward body
     def stage_body(s: int):

@@ -423,6 +423,15 @@ class TimeCostModel:
             overlap, rest = dp_time, bct - dp_time / pha.bct_overlap_coe
         return overlap, max(rest, 0.0)
 
+    def gen_result_split(self):
+        """(fwd_ms, bwd_ms) per layer, summing to gen_result(): the tick-level
+        pipeline model prices forward and backward slots separately
+        (pipeline_1f1b.build_schedule — a tick may host one fwd AND one bwd).
+        Comm/overlap terms are apportioned by the compute ratio."""
+        total = self.gen_result()
+        frac = self.fct / max(self.fct + self.bct, 1e-9)
+        return total * frac, total * (1.0 - frac)
+
     def gen_result(self) -> float:
         pha = self.pha
         if self.tp_size == 1 and self.dp_size > 1:
@@ -575,6 +584,42 @@ def get_time_cost_all_stages(layer_timecosts, pp_stage_division):
     return out
 
 
+def schedule_total_time(stage_fwd, stage_bwd, pp: int, chunks: int) -> float:
+    """Total iteration time of the 1F1B engine's lockstep schedule.
+
+    Mirrors pipeline_1f1b.build_schedule's slot equations exactly (kept
+    dependency-free so the search engine stays jax-free; the mirror is pinned
+    by tests/search_engine/test_cost_model.py::test_schedule_mirror):
+
+      fwd(i, s) = s + i        for i < pp - s      (warmup)
+                  2 i + s      otherwise           (steady/cooldown)
+      bwd(j, s) = 2 j + 2 pp - s
+      T         = 2 chunks + 2 pp
+
+    Every stage executes every tick in lockstep (ONE cross-stage collective
+    per tick), so a tick costs the slowest stage's work that tick — a fwd
+    microbatch, a bwd microbatch, or both (the slot parities coincide in the
+    steady state). This prices warmup/steady/cooldown per stage instead of
+    the old max(stage) x ticks upper bound."""
+    total = 0.0
+    for t in range(2 * chunks + 2 * pp):
+        tick = 0.0
+        for s in range(pp):
+            c = 0.0
+            i = t - s
+            fw = 0 <= i < min(chunks, pp - s)
+            if not fw and i >= 0 and i % 2 == 0 and pp - s <= i // 2 < chunks:
+                fw = True
+            if fw:
+                c += stage_fwd[s]
+            j2 = t - 2 * pp + s
+            if j2 >= 0 and j2 % 2 == 0 and j2 // 2 < chunks:
+                c += stage_bwd[s]
+            tick = max(tick, c)
+        total += tick
+    return total
+
+
 def pipeline_costmodel(
     timecostmodel,
     layer_num_list,
@@ -626,16 +671,45 @@ def pipeline_costmodel(
     if other_time_cost is not None:
         assert len(other_time_cost) == len(stage_costs)
         stage_costs = [a + b / chunks for a, b in zip(stage_costs, other_time_cost)]
-    # pipeline fill+drain: the scan pipeline runs (chunks + pp - 1) ticks;
-    # the 1F1B engine's single-collective-per-tick schedule adds one more
-    # (head/loss lags the exit by a tick, pipeline_1f1b.build_schedule)
     pipedream = bool(
         parallel_args_list
         and getattr(parallel_args_list[0], "pipeline_type", "gpipe") == "pipedream_flush"
         and len(partition) > 1
     )
-    ticks = chunks + len(partition) - 1 + (1 if pipedream else 0)
-    result = max(stage_costs) * ticks
+    if pipedream:
+        # exact tick pricing of the 1F1B engine's lockstep schedule: split
+        # each stage's per-microbatch cost into fwd/bwd slots and walk the
+        # slot equations (VERDICT r3 item 9; replaces max(stage)*ticks)
+        fwd_layer, bwd_layer = [], []
+        for i, s in enumerate(strategies):
+            t = layer_type_ids[i]
+            key = form_strategy(s)
+            f, b = cache[t][key + "#split"] if key + "#split" in cache[t] else cache[t].setdefault(
+                key + "#split",
+                timecostmodel(
+                    s, mb_bsz,
+                    model_args=model_args_list[t],
+                    train_args=train_args_list[t],
+                    parallel_args=parallel_args_list[t],
+                    profile_model_args=profile_model_args_list[t],
+                    profile_hardware_args=profile_hardware_args_list[t],
+                    logger=logger,
+                ).gen_result_split(),
+            )
+            fwd_layer.append(f)
+            bwd_layer.append(b)
+        stage_fwd = get_time_cost_all_stages(fwd_layer, partition)
+        stage_bwd = get_time_cost_all_stages(bwd_layer, partition)
+        if other_time_cost is not None:
+            # embed (first stage) / head (last stage) work runs on that
+            # stage's fwd slots: charged once per microbatch
+            stage_fwd = [a + b / chunks for a, b in zip(stage_fwd, other_time_cost)]
+        result = schedule_total_time(stage_fwd, stage_bwd, len(partition), chunks)
+    else:
+        # scan (GPipe) pipeline fill+drain: (chunks + pp - 1) ticks, each
+        # costing the slowest stage's fwd+bwd
+        ticks = chunks + len(partition) - 1
+        result = max(stage_costs) * ticks
     if return_stage_cost:
         return stage_costs, result
     return result

@@ -58,7 +58,9 @@ class SearchArgs:
     settle_bsz: Optional[int] = None
     settle_chunk: Optional[int] = None
     fine_grained_mode: bool = True
-    use_pipeline_costmodel: bool = False
+    # tick-exact 1F1B pricing (cost_model.schedule_total_time) — on by
+    # default since r4; the reference defaults its cruder variant off
+    use_pipeline_costmodel: bool = True
     mixed_precision: bool = True
     default_dp_type: str = "ddp"
     embed_sdp: int = -1  # -1: search both; 0/1: fixed

@@ -99,3 +99,46 @@ def test_eval_loop_and_resume_preserves_split(devices8, tmp_path):
     assert it4 == 4
     assert abs(v4 - vlosses[1]) < 1e-6, (v4, vlosses[1])
     assert abs(s2["test_loss"] - s1["test_loss"]) < 1e-6
+
+
+def test_t5_trains_on_real_span_corruption_data(devices8, tmp_path):
+    """--data_path for seq2seq: span-corruption batches from an indexed
+    corpus (VERDICT r3 item 7; reference T5MaskedWordPieceDataset)."""
+    from galvatron_tpu.data.dataset import write_indexed_dataset
+
+    rng = np.random.RandomState(21)
+    path = str(tmp_path / "corpus")
+    write_indexed_dataset(
+        path, [rng.randint(0, 200, rng.randint(40, 90)).tolist() for _ in range(40)]
+    )
+    s = run([
+        "--world_size", "8", "--data_path", path, "--split", "80,10,10",
+        "--train_iters", "2",
+    ], argv_base=[
+        "--model_type", "t5", "--model_size", "t5-test",
+        "--mixed_precision", "fp32", "--global_train_batch_size", "8",
+        "--lr", "1e-3",
+    ])
+    assert len(s["losses"]) == 2 and np.isfinite(s["losses"]).all()
+
+
+def test_swin_trains_on_real_npy_shards(devices8, tmp_path):
+    """--data_path for vision: npy image/label shards (VERDICT r3 item 7)."""
+    from galvatron_tpu.data.dataset import write_vision_dataset
+
+    rng = np.random.RandomState(22)
+    path = str(tmp_path / "imgs")
+    write_vision_dataset(
+        path,
+        rng.randint(0, 256, (40, 64, 64, 3)).astype(np.uint8),
+        rng.randint(0, 10, 40),
+    )
+    s = run([
+        "--world_size", "8", "--data_path", path, "--split", "80,10,10",
+        "--train_iters", "2",
+    ], argv_base=[
+        "--model_type", "swin", "--model_size", "swin-test",
+        "--mixed_precision", "fp32", "--global_train_batch_size", "8",
+        "--lr", "1e-3",
+    ])
+    assert len(s["losses"]) == 2 and np.isfinite(s["losses"]).all()

@@ -141,3 +141,150 @@ def test_split_streams_disjoint_and_deterministic(tmp_path):
         # (contiguous split -> the stream is one contiguous region per epoch
         # permutation; weaker containment check: all tokens appear in valid docs)
         assert np.isin(row, valid_tokens).all()
+
+
+def test_t5_span_corruption_reconstructs():
+    """Encoder + decoder streams jointly reconstruct the original window:
+    splicing each decoder span back at its sentinel position in the encoder
+    stream yields the source tokens (the denoising objective's invariant)."""
+    from galvatron_tpu.data.dataset import t5_span_corrupt
+
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(0, 1000, 64).astype(np.int32)
+    enc, dec = t5_span_corrupt(
+        np.array(tokens), np.random.RandomState(1), vocab_size=32128,
+        noise_density=0.15, mean_span_len=3.0,
+    )
+    sentinels = set(range(32128 - 100, 32128))
+    # decoder: sentinel-delimited spans; rebuild {sentinel -> span tokens}
+    spans, cur = {}, None
+    for t in dec:
+        if int(t) in sentinels:
+            cur = int(t)
+            spans.setdefault(cur, [])
+        else:
+            spans[cur].append(int(t))
+    rebuilt = []
+    for t in enc:
+        if int(t) in sentinels:
+            rebuilt.extend(spans.get(int(t), []))
+        else:
+            rebuilt.append(int(t))
+    np.testing.assert_array_equal(np.asarray(rebuilt, np.int32), tokens)
+    # noise actually applied, roughly at the requested density
+    n_masked = sum(len(v) for v in spans.values())
+    assert 4 <= n_masked <= 20  # 15% of 64 ~ 10
+    # deterministic
+    enc2, dec2 = t5_span_corrupt(
+        np.array(tokens), np.random.RandomState(1), vocab_size=32128,
+        noise_density=0.15, mean_span_len=3.0,
+    )
+    np.testing.assert_array_equal(enc, enc2)
+    np.testing.assert_array_equal(dec, dec2)
+
+
+def test_t5_iterator_contract_and_resume(tmp_path):
+    from galvatron_tpu.config.strategy import HybridParallelConfig
+    from galvatron_tpu.data.dataset import t5_data_iterator
+
+    rng = np.random.RandomState(4)
+    path = str(tmp_path / "corpus")
+    write_indexed_dataset(path, _docs(rng, n_docs=40, vocab=500))
+    hp = HybridParallelConfig.uniform(1, 2, global_bsz=2)
+    kw = dict(enc_seq_len=32, dec_seq_len=32, seed=3, n_samples=64,
+              split_weights="80,10,10", vocab_size=1000)
+    it = t5_data_iterator(path, hp, **kw)
+    b0, b1 = next(it), next(it)
+    assert b0["tokens"].shape == (2, 32) and b0["dec_tokens"].shape == (2, 32)
+    # teacher forcing: dec input is labels shifted right behind start id 0
+    lm = np.asarray(b0["loss_mask"][0]).astype(bool)
+    lab = np.asarray(b0["labels"][0])[lm]
+    dec = np.asarray(b0["dec_tokens"][0])
+    assert dec[0] == 0
+    np.testing.assert_array_equal(dec[1 : len(lab)], lab[:-1])
+    # resume: skipping one step reproduces batch 1
+    it2 = t5_data_iterator(path, hp, start_step=1, **kw)
+    r1 = next(it2)
+    np.testing.assert_array_equal(np.asarray(b1["tokens"]), np.asarray(r1["tokens"]))
+    np.testing.assert_array_equal(np.asarray(b1["labels"]), np.asarray(r1["labels"]))
+
+
+def test_vision_iterator_and_resume(tmp_path):
+    from galvatron_tpu.config.strategy import HybridParallelConfig
+    from galvatron_tpu.data.dataset import (
+        vision_data_iterator,
+        write_vision_dataset,
+    )
+
+    rng = np.random.RandomState(5)
+    path = str(tmp_path / "imgs")
+    images = rng.randint(0, 256, (30, 16, 16, 3)).astype(np.uint8)
+    labels = rng.randint(0, 10, 30)
+    write_vision_dataset(path, images, labels)
+    hp = HybridParallelConfig.uniform(1, 2, global_bsz=4)
+    kw = dict(image_size=16, num_channels=3, seed=2, split_weights="80,10,10")
+    it = vision_data_iterator(path, hp, **kw)
+    b0, b1 = next(it), next(it)
+    assert b0["pixels"].shape == (4, 16, 16, 3)
+    assert float(np.asarray(b0["pixels"]).max()) <= 1.0  # uint8 normalised
+    it2 = vision_data_iterator(path, hp, start_step=1, **kw)
+    r1 = next(it2)
+    np.testing.assert_array_equal(np.asarray(b1["pixels"]), np.asarray(r1["pixels"]))
+    np.testing.assert_array_equal(np.asarray(b1["labels"]), np.asarray(r1["labels"]))
+    # wrong geometry fails loudly
+    with pytest.raises(ValueError, match="model expects"):
+        next(vision_data_iterator(path, hp, image_size=32, num_channels=3))
+
+
+def test_blending_indices_track_weights():
+    from galvatron_tpu.data.dataset import build_blending_indices
+
+    ds_idx, ds_sample = build_blending_indices([0.7, 0.2, 0.1], 1000)
+    counts = np.bincount(ds_idx, minlength=3)
+    np.testing.assert_allclose(counts / 1000.0, [0.7, 0.2, 0.1], atol=0.01)
+    # every prefix tracks the weights (the greedy invariant)
+    for n in (10, 100, 500):
+        c = np.bincount(ds_idx[:n], minlength=3)
+        np.testing.assert_allclose(c / n, [0.7, 0.2, 0.1], atol=0.15)
+    # within-dataset ids are sequential per dataset
+    for j in range(3):
+        np.testing.assert_array_equal(ds_sample[ds_idx == j],
+                                      np.arange(int(counts[j])))
+    # native and numpy agree
+    from galvatron_tpu.data import dataset as D
+
+    lib, D._lib = D._lib, None
+    try:
+        import unittest.mock as mock
+
+        with mock.patch.object(D, "_load_helpers", return_value=None):
+            py_idx, py_sample = build_blending_indices([0.7, 0.2, 0.1], 1000)
+    finally:
+        D._lib = lib
+    np.testing.assert_array_equal(ds_idx, py_idx)
+    np.testing.assert_array_equal(ds_sample, py_sample)
+
+
+def test_blended_corpus_stream_resume(tmp_path):
+    """Megatron-style "W1 P1 W2 P2" --data_path: proportions honoured and the
+    stream resumes deterministically (VERDICT r3 item 8)."""
+    from galvatron_tpu.config.strategy import HybridParallelConfig
+    from galvatron_tpu.data.dataset import gpt_data_iterator
+
+    rng = np.random.RandomState(9)
+    pa, pb = str(tmp_path / "a"), str(tmp_path / "b")
+    # disjoint vocab ranges so provenance is visible in the tokens
+    write_indexed_dataset(pa, [rng.randint(0, 50, 30).tolist() for _ in range(20)])
+    write_indexed_dataset(pb, [rng.randint(50, 100, 30).tolist() for _ in range(20)])
+    hp = HybridParallelConfig.uniform(1, 2, global_bsz=2)
+    blend = "0.75 %s 0.25 %s" % (pa, pb)
+    kw = dict(seq_len=16, seed=3, n_samples=400, split_weights="1,0,0")
+    it = gpt_data_iterator(blend, hp, **kw)
+    batches = [next(it) for _ in range(40)]
+    toks = np.concatenate([np.asarray(b["tokens"]).ravel() for b in batches])
+    frac_a = float((toks < 50).mean())
+    assert 0.65 < frac_a < 0.85, frac_a
+    # resume: fresh iterator skipping 5 steps reproduces batch 5
+    it2 = gpt_data_iterator(blend, hp, start_step=5, **kw)
+    r5 = next(it2)
+    np.testing.assert_array_equal(np.asarray(batches[5]["tokens"]), np.asarray(r5["tokens"]))
