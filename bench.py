@@ -18,22 +18,28 @@ adam — on LLaMA-7B layer shapes (hidden 4096, ffn 11008, 32 heads, seq 2048,
 bf16 compute / fp32 adam), reported as tokens/sec/chip and MFU against the
 chip's peak bf16 matmul throughput.
 
+Wedge-proofing (round 5): the axon remote-compile endpoint has been observed
+to wedge mid-run (BENCH_r04 rc=124 lost every already-measured number). This
+process is therefore a pure ORCHESTRATOR that never imports jax; each metric
+section runs in a fresh subprocess (fresh tunnel connection) with its own
+timeout and one retry, a global deadline caps total runtime, and the final
+JSON line is always printed with whatever was measured — exit code 0 even if
+every section fails.
+
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
 """
 
 import json
 import os
+import signal
+import subprocess
+import sys
 import time
-from functools import partial
-
-import numpy as np
-
-import jax
-import jax.numpy as jnp
 
 REFERENCE_MS_PER_LAYER_PER_SAMPLE = 5.331
 
 SMOKE = bool(os.environ.get("GALVATRON_BENCH_SMOKE"))
+SECTION = os.environ.get("GALVATRON_BENCH_SECTION")
 
 # GPT layer-forward parity config (the reference's measured layer)
 HIDDEN, HEADS, SEQ = (512, 8, 256) if SMOKE else (4096, 32, 2048)
@@ -48,6 +54,12 @@ L7B_HIDDEN, L7B_FFN, L7B_HEADS, L7B_SEQ = (512, 1376, 8, 256) if SMOKE else (409
 L7B_LAYERS = 2
 L7B_BATCH = 1 if SMOKE else 4
 
+# steps executed back-to-back inside one jitted scan per timed call: the
+# ~70 ms axon-tunnel dispatch latency amortises away and the measurement is
+# the DEVICE step time, as in real training where dispatch runs ahead of the
+# device (same differencing rationale as the layer-fwd metric)
+STEPS_PER_CALL = 1 if SMOKE else 8
+
 # peak dense bf16 matmul throughput per chip, FLOP/s
 PEAK_FLOPS_BY_KIND = {
     "TPU v4": 275e12,
@@ -61,22 +73,25 @@ PEAK_FLOPS_BY_KIND = {
 }
 
 
-def _peak_flops():
-    kind = jax.devices()[0].device_kind
-    for k, v in PEAK_FLOPS_BY_KIND.items():
-        if kind.lower().startswith(k.lower()):
-            return v, kind
-    return None, kind
+# =========================================================================
+# Section implementations — run in a fresh child process each; jax is only
+# imported here, never in the orchestrator.
+# =========================================================================
 
 
 def _sync(x):
     # NB: block_until_ready does not reliably block on the experimental axon
     # tunnel backend; a host transfer of a scalar does.
+    import jax
+    import jax.numpy as jnp
+
     return float(jnp.sum(jax.tree.leaves(x)[0].astype(jnp.float32)))
 
 
-# ------------------------------------------------------- layer-forward parity
-def build_stack(n_layers):
+def _build_stack(n_layers):
+    import jax
+    import jax.numpy as jnp
+
     from galvatron_tpu.models import base as M
 
     cfg = M.TransformerConfig(
@@ -99,7 +114,9 @@ def build_stack(n_layers):
     return jax.jit(fwd), layers, x
 
 
-def time_stack(fwd, layers, x):
+def _time_stack(fwd, layers, x):
+    import numpy as np
+
     for _ in range(WARMUP):
         float(fwd(layers, x))
     times = []
@@ -110,30 +127,30 @@ def time_stack(fwd, layers, x):
     return float(np.median(times))
 
 
-def layer_fwd_metric():
-    f_lo, l_lo, x_lo = build_stack(N_LO)
-    f_hi, l_hi, x_hi = build_stack(N_HI)
+def section_layer_fwd():
+    import numpy as np
+
+    f_lo, l_lo, x_lo = _build_stack(N_LO)
+    f_hi, l_hi, x_hi = _build_stack(N_HI)
     per_round = []
     for _ in range(ROUNDS):
-        t_lo = time_stack(f_lo, l_lo, x_lo)
-        t_hi = time_stack(f_hi, l_hi, x_hi)
+        t_lo = _time_stack(f_lo, l_lo, x_lo)
+        t_hi = _time_stack(f_hi, l_hi, x_hi)
         per_round.append((t_hi - t_lo) / (N_HI - N_LO) / BATCH * 1e3)
-    best = float(np.min(per_round))
     med = float(np.median(per_round))
-    spread = float((np.max(per_round) - np.min(per_round)) / max(med, 1e-9))
-    return best, med, spread
+    return {
+        "layer_fwd_ms": float(np.min(per_round)),
+        "layer_fwd_ms_median": round(med, 4),
+        "layer_fwd_round_spread": round(
+            float((np.max(per_round) - np.min(per_round)) / max(med, 1e-9)), 4
+        ),
+        "rounds": ROUNDS,
+    }
 
 
-# ------------------------------------------------- LLaMA-7B-layer train step
-# steps executed back-to-back inside one jitted scan per timed call: the
-# ~70 ms axon-tunnel dispatch latency amortises away and the measurement is
-# the DEVICE step time, as in real training where dispatch runs ahead of the
-# device (same differencing rationale as layer_fwd_metric; round 3 measured
-# single synced calls and under-reported MFU 0.38 vs the true ~0.6)
-STEPS_PER_CALL = 1 if SMOKE else 8
-
-
-def train_step_metric():
+def _l7b_setup():
+    import jax
+    import jax.numpy as jnp
     import optax
 
     from galvatron_tpu.models import base as M
@@ -151,6 +168,40 @@ def train_step_metric():
     positions = jnp.broadcast_to(jnp.arange(L7B_SEQ), (L7B_BATCH, L7B_SEQ))
     tx = optax.adam(1e-4)
     opt_state = tx.init(layers)
+    return M, cfg, layers, x, positions, tx, opt_state
+
+
+def _l7b_flops_tokens(layers):
+    import jax
+    import numpy as np
+
+    tokens = L7B_BATCH * L7B_SEQ
+    n_params = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(layers))
+    # model FLOPs: 6 * params * tokens (fwd 2x + bwd 4x) + causal attention
+    # 12 * L * S * H * tokens * 0.5 (PaLM appendix-B convention)
+    flops = 6.0 * n_params * tokens + 12 * L7B_LAYERS * L7B_SEQ * L7B_HIDDEN * tokens * 0.5
+    return flops, tokens, n_params
+
+
+def _peak_flops():
+    import jax
+
+    kind = jax.devices()[0].device_kind
+    for k, v in PEAK_FLOPS_BY_KIND.items():
+        if kind.lower().startswith(k.lower()):
+            return v, kind
+    return None, kind
+
+
+def section_train_step():
+    import numpy as np
+
+    import jax
+    import optax
+    from functools import partial
+
+    M, cfg, layers, x, positions, tx, opt_state = _l7b_setup()
+    import jax.numpy as jnp
 
     def loss_fn(layers, x):
         y = x
@@ -173,8 +224,7 @@ def train_step_metric():
         return carry, losses[-1]
 
     carry = (layers, opt_state)
-    # warmup (compile + first run)
-    carry, loss = run_steps(carry)
+    carry, loss = run_steps(carry)  # warmup (compile + first run)
     _sync(loss)
     rounds = []
     for _ in range(ROUNDS):
@@ -186,113 +236,235 @@ def train_step_metric():
             times.append(time.perf_counter() - t0)
         rounds.append(float(np.median(times)) / STEPS_PER_CALL)
     step_s = float(np.min(rounds))
-    layers = carry[0]
 
-    # component breakdown (VERDICT r3: record where the step time goes);
-    # guarded — a tunnel compile failure OR HANG must not lose the headline
-    # metric (the axon remote-compile endpoint has been observed to wedge)
-    breakdown = {}
-    import signal
-
-    def _alarm(signum, frame):
-        raise TimeoutError("breakdown compile/run exceeded budget")
-
-    old_handler = signal.signal(signal.SIGALRM, _alarm)
-    signal.alarm(180)
-    try:
-        K = STEPS_PER_CALL
-
-        @jax.jit
-        def fwd_k(xx):
-            def body(c, _):
-                y = c
-                for lp in layers:
-                    y = M.layer_forward(lp, y, positions, cfg)
-                return 0.5 * c + 0.5 * y, ()
-            out, _ = jax.lax.scan(body, xx, None, length=K)
-            return out
-
-        grads = jax.tree.map(jnp.zeros_like, layers)
-
-        @jax.jit
-        def adam_k(carry):
-            def body(c, _):
-                ls, st = c
-                updates, st = tx.update(grads, st, ls)
-                return (optax.apply_updates(ls, updates), st), ()
-            out, _ = jax.lax.scan(body, carry, None, length=K)
-            return out
-
-        def _time(fn, *a):
-            _sync(fn(*a))
-            ts = []
-            for _ in range(3):
-                t0 = time.perf_counter()
-                _sync(fn(*a))
-                ts.append(time.perf_counter() - t0)
-            return float(np.min(ts)) / K
-
-        t_fwd = _time(fwd_k, x)
-        t_adam = _time(adam_k, (layers, opt_state))
-        breakdown = {
-            "fwd_ms": round(t_fwd * 1e3, 2),
-            "adam_ms": round(t_adam * 1e3, 2),
-            "bwd_plus_overhead_ms": round((step_s - t_fwd - t_adam) * 1e3, 2),
-        }
-    except Exception as e:  # pragma: no cover - tunnel flakiness
-        breakdown = {"error": str(e)[:120]}
-    finally:
-        signal.alarm(0)
-        signal.signal(signal.SIGALRM, old_handler)
-
-    tokens = L7B_BATCH * L7B_SEQ
-    n_params = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(layers))
-    # model FLOPs: 6 * params * tokens (fwd 2x + bwd 4x) + causal attention
-    # 12 * L * S * H * tokens * 0.5 (PaLM appendix-B convention)
-    flops = 6.0 * n_params * tokens + 12 * L7B_LAYERS * L7B_SEQ * L7B_HIDDEN * tokens * 0.5
+    flops, tokens, n_params = _l7b_flops_tokens(carry[0])
     peak, kind = _peak_flops()
-    tokens_per_sec = tokens / step_s
-    mfu = (flops / step_s / peak) if peak else None
     return {
         "config": "llama7b_layer_stack%d_seq%d_bf16_adam" % (L7B_LAYERS, L7B_SEQ),
         "step_ms": round(step_s * 1e3, 3),
         "steps_per_call": STEPS_PER_CALL,
-        "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
-        "mfu": round(mfu, 4) if mfu is not None else None,
+        "tokens_per_sec_per_chip": round(tokens / step_s, 1),
+        "mfu": round(flops / step_s / peak, 4) if peak else None,
         "device_kind": kind,
         "params": n_params,
-        "breakdown": breakdown,
     }
+
+
+def section_breakdown():
+    """fwd / adam component timings; bwd is the step-time remainder (the
+    parent passes the measured step_ms via GALVATRON_BENCH_STEP_MS)."""
+    import numpy as np
+
+    import jax
+    import optax
+
+    M, cfg, layers, x, positions, tx, opt_state = _l7b_setup()
+    K = STEPS_PER_CALL
+
+    @jax.jit
+    def fwd_k(xx):
+        def body(c, _):
+            y = c
+            for lp in layers:
+                y = M.layer_forward(lp, y, positions, cfg)
+            return 0.5 * c + 0.5 * y, ()
+
+        out, _ = jax.lax.scan(body, xx, None, length=K)
+        return out
+
+    # grads are a jit ARGUMENT filled with random data: a closed-over zeros
+    # tree would let XLA constant-fold the zero-multiply chains and
+    # under-report the real optimizer cost (ADVICE r4)
+    grads = jax.tree.map(
+        lambda k, l: 1e-3 * jax.random.normal(k, l.shape, l.dtype),
+        jax.tree.unflatten(
+            jax.tree.structure(layers),
+            list(jax.random.split(jax.random.PRNGKey(2), len(jax.tree.leaves(layers)))),
+        ),
+        layers,
+    )
+
+    @jax.jit
+    def adam_k(carry, grads):
+        def body(c, _):
+            ls, st = c
+            updates, st = tx.update(grads, st, ls)
+            return (optax.apply_updates(ls, updates), st), ()
+
+        out, _ = jax.lax.scan(body, carry, None, length=K)
+        return out
+
+    def _time(fn, *a):
+        _sync(fn(*a))
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            _sync(fn(*a))
+            ts.append(time.perf_counter() - t0)
+        return float(np.min(ts)) / K
+
+    t_fwd = _time(fwd_k, x)
+    t_adam = _time(adam_k, (layers, opt_state), grads)
+    out = {"fwd_ms": round(t_fwd * 1e3, 2), "adam_ms": round(t_adam * 1e3, 2)}
+    step_ms = os.environ.get("GALVATRON_BENCH_STEP_MS")
+    if step_ms:
+        out["bwd_plus_overhead_ms"] = round(float(step_ms) - out["fwd_ms"] - out["adam_ms"], 2)
+    return out
+
+
+SECTIONS = {
+    "layer_fwd": section_layer_fwd,
+    "train_step": section_train_step,
+    "breakdown": section_breakdown,
+}
+
+
+# =========================================================================
+# Orchestrator — never imports jax, so it cannot wedge on the tunnel.
+# =========================================================================
+
+# The external driver killed round 4's bench at its own timeout (rc=124);
+# common budgets are 900s, so the normal-path emit must land by ~780s and the
+# last-resort watchdog by ~800s — comfortably inside.
+DEADLINE_S = float(os.environ.get("GALVATRON_BENCH_DEADLINE", "200" if SMOKE else "780"))
+SECTION_BUDGETS = {"layer_fwd": 300.0, "train_step": 360.0, "breakdown": 200.0}
+_START = time.time()
+_ACTIVE_CHILD = None  # Popen of the in-flight section, for watchdog cleanup
+
+
+def _remaining():
+    return DEADLINE_S - (time.time() - _START)
+
+
+def _kill_active_child():
+    child = _ACTIVE_CHILD
+    if child is not None and child.poll() is None:
+        try:
+            os.killpg(child.pid, signal.SIGKILL)
+        except (OSError, ProcessLookupError):
+            child.kill()
+
+
+def _extract_json(stdout):
+    for line in reversed((stdout or "").strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                return None
+    return None
+
+
+def _run_section(name, errors, extra_env=None):
+    """Run one section in a fresh subprocess; one retry; None on failure."""
+    global _ACTIVE_CHILD
+    budget = SECTION_BUDGETS[name]
+    for attempt in (1, 2):
+        b = min(budget, _remaining() - 10.0)
+        if b < 45.0:
+            errors.setdefault(name, "skipped: deadline exhausted")
+            return None
+        env = dict(os.environ)
+        env["GALVATRON_BENCH_SECTION"] = name
+        env.update(extra_env or {})
+        # own process group so a wedged child (and any helpers) can be
+        # SIGKILLed as a unit, including from the watchdog
+        p = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, start_new_session=True,
+        )
+        _ACTIVE_CHILD = p
+        try:
+            out, err = p.communicate(timeout=b)
+        except subprocess.TimeoutExpired:
+            _kill_active_child()
+            try:
+                out, err = p.communicate(timeout=10.0)
+            except subprocess.TimeoutExpired:
+                out, err = "", ""
+            _ACTIVE_CHILD = None
+            errors[name] = "attempt %d: timeout after %.0fs (tunnel wedge?)" % (attempt, b)
+            continue
+        _ACTIVE_CHILD = None
+        # keep whatever was measured: a child that printed its JSON but died
+        # in teardown (flaky tunnel destructors) still counts as success
+        result = _extract_json(out)
+        if result is not None:
+            errors.pop(name, None)
+            return result
+        if p.returncode == 0:
+            errors[name] = "attempt %d: no JSON in section output" % attempt
+        else:
+            tail = (err or "").strip().splitlines()[-3:]
+            errors[name] = "attempt %d: rc=%d %s" % (attempt, p.returncode, " | ".join(tail)[:200])
+    return None
 
 
 def main():
-    best, med, spread = layer_fwd_metric()
-    extra = {
-        "layer_fwd_ms_median": round(med, 4),
-        "layer_fwd_round_spread": round(spread, 4),
-        "rounds": ROUNDS,
-        "train_step": train_step_metric(),
-    }
-    metric = (
-        "SMOKE_gpt_layer_fwd_ms_h%d_s%d" % (HIDDEN, SEQ)
-        if SMOKE else "gpt_layer_fwd_ms_per_layer_per_sample_h4096_s2048_bf16"
-    )
-    print(
-        json.dumps(
-            {
-                "metric": metric,
-                "value": round(best, 4),
-                "unit": "ms",
-                # the baseline is the full-shape reference number; a smoke run
-                # measures different shapes and must not claim a ratio
-                "vs_baseline": None if SMOKE else round(
-                    REFERENCE_MS_PER_LAYER_PER_SAMPLE / best, 4
-                ),
-                "extra": extra,
-            }
+    results, errors = {}, {}
+
+    def emit_and_exit(signum=None, frame=None):
+        layer = results.get("layer_fwd") or {}
+        best = layer.get("layer_fwd_ms")
+        extra = {k: v for k, v in layer.items() if k != "layer_fwd_ms"}
+        train = results.get("train_step")
+        if train is not None:
+            if results.get("breakdown"):
+                train = dict(train, breakdown=results["breakdown"])
+            extra["train_step"] = train
+        elif "train_step" in errors:
+            extra["train_step"] = {"error": errors["train_step"]}
+        if errors:
+            extra["errors"] = errors
+        _kill_active_child()  # don't leave a wedged child squatting the chip
+        metric = (
+            "SMOKE_gpt_layer_fwd_ms_h%d_s%d" % (HIDDEN, SEQ)
+            if SMOKE else "gpt_layer_fwd_ms_per_layer_per_sample_h4096_s2048_bf16"
         )
-    )
+        print(json.dumps({
+            "metric": metric,
+            "value": round(best, 4) if best is not None else None,
+            "unit": "ms",
+            # the baseline is the full-shape reference number; a smoke run
+            # measures different shapes and must not claim a ratio
+            "vs_baseline": None if (SMOKE or best is None) else round(
+                REFERENCE_MS_PER_LAYER_PER_SAMPLE / best, 4
+            ),
+            "extra": extra,
+        }))
+        sys.stdout.flush()
+        # always 0: a partial bench is a result, not a failure
+        os._exit(0)
+
+    # last-resort watchdog: even if the orchestrator itself stalls (e.g. in
+    # communicate() on a wedged child), the JSON line with whatever was
+    # measured still goes out, and the child is killed so it can't keep
+    # squatting the shared chip
+    signal.signal(signal.SIGALRM, emit_and_exit)
+    signal.alarm(int(DEADLINE_S + 20))
+
+    results["layer_fwd"] = _run_section("layer_fwd", errors)
+    results["train_step"] = _run_section("train_step", errors)
+    if results["train_step"] is not None:
+        results["breakdown"] = _run_section(
+            "breakdown", errors,
+            extra_env={"GALVATRON_BENCH_STEP_MS": str(results["train_step"]["step_ms"])},
+        )
+    emit_and_exit()
 
 
 if __name__ == "__main__":
-    main()
+    if SECTION:
+        # honor an explicit non-axon JAX_PLATFORMS (CPU validation runs):
+        # the axon plugin pins jax_platforms at registration, and only
+        # config.update outranks it
+        _jp = os.environ.get("JAX_PLATFORMS")
+        if _jp and "axon" not in _jp:
+            import jax
+
+            jax.config.update("jax_platforms", _jp)
+        print(json.dumps(SECTIONS[SECTION]()))
+    else:
+        main()
