@@ -73,7 +73,10 @@ void build_blending_indices(const double* weights,
         int64_t best = 0;
         double best_err = 0.0;
         for (int64_t j = 0; j < n_datasets; ++j) {
-            double err = (double)(counts[j] + 1) / ((double)(i + 1) * weights[j]);
+            // key = (count+1)/w — the per-step common 1/(i+1) factor is
+            // dropped so the numpy fallback (a lexsort merge of the same
+            // per-dataset key sequences) computes bit-identical doubles
+            double err = (double)(counts[j] + 1) / weights[j];
             if (j == 0 || err < best_err) {
                 best = j;
                 best_err = err;

@@ -250,17 +250,8 @@ def gpt_data_iterator(
     content is a pure function of the step index, so resume passes
     `start_step` (O(1) skip); split ranges and the blend schedule are pure
     functions of the corpora + weights, so resume sees the same streams."""
-    weights, prefixes = parse_blend(data_path)
-    total = n_samples or 1_000_000
-    per_corpus = []
-    for k, prefix in enumerate(prefixes):
-        indexed = IndexedDataset(prefix)
-        docs = split_doc_ids(indexed.n_docs, split_weights)[split]
-        per_corpus.append(GPTDataset(
-            indexed, seq_len, total, seed=seed + k, documents=docs,
-        ))
-    ds = (per_corpus[0] if len(per_corpus) == 1
-          else BlendedGPTDataset(per_corpus, weights, total))
+    ds = _build_lm_dataset(data_path, seq_len, n_samples or 1_000_000,
+                           seed, split, split_weights)
     step = start_step
     while True:
         rows = [ds[step * hp.global_bsz + b] for b in range(hp.global_bsz)]
@@ -301,29 +292,68 @@ def build_blending_indices(weights: Sequence[float], n_samples: int):
             ds_sample.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         )
         return ds_index, ds_sample
-    counts = np.zeros(len(w), np.int64)
-    for i in range(n_samples):
-        # error of adding one sample to each dataset; pick the most lagging
-        j = int(np.argmin((counts + 1) / ((i + 1) * w)))
-        ds_index[i] = j
-        ds_sample[i] = counts[j]
-        counts[j] += 1
+    # The greedy schedule (repeatedly pick argmin_k (count_k+1)/w_k, first
+    # index on ties) is exactly a merge of the per-dataset key sequences
+    # (j+1)/w_k, each strictly increasing — so it vectorizes to one lexsort
+    # instead of an O(n_samples * n_datasets) interpreted loop (ADVICE r4).
+    # Keys are the same doubles the native helper computes, so both paths
+    # produce identical schedules including tie cases.
+    # cap per-dataset keys at its share plus slack: at the n-th smallest key P,
+    # n = sum_k floor(P*w_k) >= P - K, so count_k = floor(P*w_k) <= ceil(n*w_k) + K
+    caps = np.minimum(
+        np.ceil(w * n_samples).astype(np.int64) + len(w) + 2, n_samples
+    )
+    ks = np.repeat(np.arange(len(w), dtype=np.int32), caps)
+    js = np.concatenate([np.arange(c, dtype=np.int64) for c in caps])
+    prio = (js + 1).astype(np.float64) / w[ks]
+    order = np.lexsort((ks, prio))[:n_samples]
+    ds_index[:] = ks[order]
+    ds_sample[:] = js[order]
     return ds_index, ds_sample
 
 
 def parse_blend(data_path: str):
     """Megatron --data-path blend syntax: "W1 PREFIX1 W2 PREFIX2 ..." (or a
-    single prefix). Returns (weights, prefixes)."""
+    single prefix). Returns (weights, prefixes). A multi-token string whose
+    first token is not a number is treated as ONE path containing whitespace,
+    not a malformed blend."""
     parts = data_path.split()
     if len(parts) <= 1:
         return [1.0], [data_path.strip() or data_path]
+    try:
+        float(parts[0])
+    except ValueError:
+        return [1.0], [data_path]
     if len(parts) % 2 != 0:
         raise ValueError(
             "blended --data_path must alternate WEIGHT PREFIX pairs, got %r" % data_path
         )
     weights = [float(parts[i]) for i in range(0, len(parts), 2)]
     prefixes = [parts[i] for i in range(1, len(parts), 2)]
+    if any(not np.isfinite(w) or w <= 0 for w in weights):
+        raise ValueError("blend weights must be positive, got %r" % weights)
     return weights, prefixes
+
+
+def _build_lm_dataset(data_path: str, seq_len: int, total: int, seed: int,
+                      split: str, split_weights: str):
+    """Single-corpus GPTDataset or weighted blend, per the --data_path form.
+    Each blended corpus is sized to roughly its weight share of `total`
+    (plus the blend schedule's slack) instead of the full total — the
+    sample-index build is the expensive part of construction (ADVICE r4)."""
+    weights, prefixes = parse_blend(data_path)
+    w = np.asarray(weights, np.float64)
+    w = w / w.sum()
+    per_corpus = []
+    for k, prefix in enumerate(prefixes):
+        indexed = IndexedDataset(prefix)
+        docs = split_doc_ids(indexed.n_docs, split_weights)[split]
+        n_k = total if len(prefixes) == 1 else int(np.ceil(w[k] * total)) + len(w) + 2
+        per_corpus.append(GPTDataset(
+            indexed, seq_len, n_k, seed=seed + k, documents=docs,
+        ))
+    return (per_corpus[0] if len(per_corpus) == 1
+            else BlendedGPTDataset(per_corpus, weights, total))
 
 
 class BlendedGPTDataset:
@@ -359,9 +389,17 @@ def t5_span_corrupt(tokens: np.ndarray, rng: np.random.RandomState, *,
     sentinel. Sentinels count down from vocab_size-1 (HF T5 extra_ids).
 
     Returns (enc_tokens, dec_target) as int32 arrays (variable length)."""
+    if not 0.0 < noise_density < 1.0:
+        raise ValueError("noise_density must be in (0, 1), got %r" % noise_density)
+    if mean_span_len <= 0:
+        raise ValueError("mean_span_len must be positive, got %r" % mean_span_len)
     L = len(tokens)
-    n_noise = max(int(round(L * noise_density)), 1)
+    n_noise = min(max(int(round(L * noise_density)), 1), max(L - 1, 1))
     n_spans = max(int(round(n_noise / mean_span_len)), 1)
+    # feasibility: the span-split draws n_spans-1 distinct cut points inside
+    # (0, n_noise) and n_spans distinct starts over the L-n_noise+1 gap slots;
+    # high noise_density / short windows would otherwise crash rng.choice
+    n_spans = min(n_spans, n_noise, L - n_noise + 1)
     # random span lengths summing to n_noise (multinomial split)
     cuts = np.sort(rng.choice(np.arange(1, n_noise), size=n_spans - 1,
                               replace=False)) if n_noise > n_spans else np.arange(1, n_spans)
@@ -406,11 +444,12 @@ def t5_data_iterator(
     """Span-corruption batch stream over one split of an indexed corpus.
     Emits the t5 batch contract (tokens/attn_mask/dec_tokens/labels/
     loss_mask) at STATIC shapes (enc_seq_len, dec_seq_len) — truncate/pad,
-    jit sees one shape. Deterministic per (corpus, weights, seed, step)."""
-    indexed = IndexedDataset(data_path)
-    docs = split_doc_ids(indexed.n_docs, split_weights)[split]
-    ds = GPTDataset(indexed, enc_seq_len, n_samples or 1_000_000, seed=seed,
-                    documents=docs)
+    jit sees one shape. `data_path` may be a single prefix or a Megatron
+    blend "W1 PREFIX1 W2 PREFIX2 ..." (blending happens on the raw windows,
+    before span corruption). Deterministic per (corpus, weights, seed,
+    step)."""
+    ds = _build_lm_dataset(data_path, enc_seq_len, n_samples or 1_000_000,
+                           seed, split, split_weights)
     step = start_step
     while True:
         enc = np.zeros((hp.global_bsz, enc_seq_len), np.int32)
@@ -468,6 +507,13 @@ def vision_data_iterator(
     of the indexed LM corpus; the reference wires megatron-style datasets for
     swin/vit but trains on largely random pixels). Samples are memmapped;
     sample order is a deterministic per-epoch permutation of the split."""
+    _, _prefixes = parse_blend(data_path)
+    if len(_prefixes) > 1:
+        raise ValueError(
+            "corpus blending (\"W1 PREFIX1 W2 PREFIX2 ...\") is not supported "
+            "for vision datasets; got --data_path %r" % data_path
+        )
+    data_path = _prefixes[0]
     img_path, lab_path = data_path + ".images.npy", data_path + ".labels.npy"
     if not os.path.exists(img_path) or not os.path.exists(lab_path):
         raise FileNotFoundError(
@@ -476,7 +522,8 @@ def vision_data_iterator(
         )
     images = np.load(img_path, mmap_mode="r")
     labels = np.load(lab_path)
-    if images.shape[1] != image_size or images.shape[3] != num_channels:
+    if (images.shape[1] != image_size or images.shape[2] != image_size
+            or images.shape[3] != num_channels):
         raise ValueError(
             "dataset images are %s; model expects (%d, %d, %d)"
             % (images.shape[1:], image_size, image_size, num_channels)
