@@ -142,13 +142,13 @@ def train(args) -> dict:
     # same batches (steps 0..eval_iters of the split stream), the per-pass
     # index rebuild is avoided, and an unusable split (--split weights that
     # leave valid/test empty for this corpus) fails BEFORE training instead
-    # of crashing the final test eval. NB for pp>1 pipedream models
-    # model.loss_fn is the 1F1B grad_fn's loss — eval pays the backward too;
-    # a forward-only pipelined eval is a known cost optimisation.
+    # of crashing the final test eval. model.eval_loss is the forward-only
+    # path where one exists (reference evaluation is forward-only); under the
+    # 1F1B engines the grad-bearing loss_fn would pay the backward too.
     eval_fn = None
     eval_batches = {}
     if eval_interval:
-        eval_fn = jax.jit(model.loss_fn)
+        eval_fn = jax.jit(model.eval_loss)
         for split in ("valid", "test"):
             it = build_data_iterator(args, fam, cfg, hp, start_step=0, split=split)
             eval_batches[split] = [

@@ -548,6 +548,7 @@ def construct_swin_model(cfg: SwinConfig, hp: HybridParallelConfig, devices=None
             make_swin_loss_and_grad,
             stack_swin_layer_specs,
             stack_swin_params,
+            unstack_swin_params,
         )
 
         specs = {
@@ -566,6 +567,22 @@ def construct_swin_model(cfg: SwinConfig, hp: HybridParallelConfig, devices=None
             out["stages"] = stack_swin_params(canonical, cfg, hp)
             return out
 
+        def eval_loss(p, b):
+            # forward-only eval: recover canonical blocks/merges from the
+            # padded slots (pure slicing under jit; outside any stage-divergent
+            # branch, so the padded-dim slice collectives are deadlock-safe)
+            # and run the unpipelined forward — same loss, no backward slots
+            canonical = {"embed": p["embed"], "final_norm": p["final_norm"],
+                         "head": p["head"]}
+            canonical.update(unstack_swin_params(p["stages"], cfg, hp))
+            return swin_loss_fn(canonical, b, cfg, hp, mesh)
+
+        # only a win at small pp — see the identical gate in models/t5.py:
+        # at pp>=3 the replicated full forward costs more time and memory
+        # than the 1F1B schedule it would replace
+        if hp.pp > 2:
+            eval_loss = None
+
         return HybridParallelModel(
             cfg=cfg,
             hp=hp,
@@ -575,6 +592,7 @@ def construct_swin_model(cfg: SwinConfig, hp: HybridParallelConfig, devices=None
             forward_fn=None,
             init_fn=init_fn,
             grad_fn=grad_fn,
+            eval_loss_fn=eval_loss,
         )
     return HybridParallelModel(
         cfg=cfg,

@@ -526,6 +526,7 @@ def construct_t5_model(cfg: T5Config, hp: HybridParallelConfig, devices=None):
             make_encdec_loss_and_grad,
             stack_t5_layer_specs,
             stack_t5_params,
+            unstack_t5_params,
             validate_encdec_config,
         )
 
@@ -543,6 +544,24 @@ def construct_t5_model(cfg: T5Config, hp: HybridParallelConfig, devices=None):
             out["stages"] = stack_t5_params(canonical, cfg, hp)
             return out
 
+        def eval_loss(p, b):
+            # forward-only eval: recover the canonical tree from the stacked
+            # slots (pure slicing under jit) and run the unpipelined forward —
+            # same loss, no 1F1B backward slots (reference eval is fwd-only)
+            canonical = {"embed": p["embed"], "dec_norm": p["dec_norm"]}
+            if not cfg.tie_embeddings:
+                canonical["lm_head"] = p["lm_head"]
+            canonical.update(unstack_t5_params(p["stages"], cfg, hp))
+            return t5_loss_fn(canonical, b, cfg, hp, mesh)
+
+        # Only a win at small pp: the unpipelined forward replicates the FULL
+        # model per pipeline group (~1.0 fwd/device + cross-pp weight gathers)
+        # vs the 1F1B loss's ~3/pp fwd-equivalents/device on 1/pp-resident
+        # weights — at pp>=3 it is slower AND raises eval peak memory on
+        # configs where pp was chosen because a stage barely fits HBM
+        if hp.pp > 2:
+            eval_loss = None
+
         return HybridParallelModel(
             cfg=cfg,
             hp=hp,
@@ -552,6 +571,7 @@ def construct_t5_model(cfg: T5Config, hp: HybridParallelConfig, devices=None):
             forward_fn=None,
             init_fn=init_fn,
             grad_fn=grad_fn,
+            eval_loss_fn=eval_loss,
         )
     return HybridParallelModel(
         cfg=cfg,

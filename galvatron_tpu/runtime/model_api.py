@@ -53,6 +53,15 @@ class HybridParallelModel:
     grad_fn: Optional[Callable] = None  # (params, batch) -> (loss, grads);
     # set by the 1f1b pipeline, whose hand-written schedule produces gradients
     # directly instead of going through jax.value_and_grad
+    eval_loss_fn: Optional[Callable] = None  # forward-only (params, batch) ->
+    # loss for evaluation: under the 1f1b engines, loss_fn is the grad-bearing
+    # schedule (loss and grads come out of one scan, so XLA cannot DCE the
+    # backward); this is the cheap path (reference evaluation is forward-only)
+
+    @property
+    def eval_loss(self) -> Callable:
+        """The loss to use for evaluation: forward-only when available."""
+        return self.eval_loss_fn or self.loss_fn
 
     # ------------------------------------------------------------------ params
     def shardings(self, specs=None):
@@ -231,15 +240,28 @@ def construct_hybrid_parallel_model(
     mesh = build_mesh(hp, devices)
     specs = M.model_param_specs(cfg, hp)
     grad_fn = None
+    eval_loss = None
     if hp.pp > 1 and hp.pipeline_type == "pipedream_flush":
         from galvatron_tpu.parallel import pipeline_1f1b
-        from galvatron_tpu.parallel.pipeline import stack_layer_specs
+        from galvatron_tpu.parallel.pipeline import (
+            make_pipelined_loss,
+            stack_layer_specs,
+        )
 
         specs = pipeline_1f1b.vocab_param_specs(cfg, hp)
         specs["stages"] = stack_layer_specs(cfg, hp)
         del specs["layers"]
         grad_fn = pipeline_1f1b.make_loss_and_grad(cfg, hp, mesh)
         base_loss = lambda p, b: grad_fn(p, b)[0]
+        # forward-only eval: the gpipe scan computes the identical loss
+        # without the 1F1B backward slots whenever the config fits its
+        # contract (even divisions, stage-uniform strategies, no cp — it
+        # validates on construction); otherwise eval falls back to the
+        # grad-bearing schedule
+        try:
+            eval_loss = make_pipelined_loss(cfg, hp, mesh)
+        except ValueError:
+            eval_loss = None
         fwd = None
     elif hp.pp > 1:
         from galvatron_tpu.parallel.pipeline import make_pipelined_loss, stack_layer_specs
@@ -268,4 +290,5 @@ def construct_hybrid_parallel_model(
         loss_fn=loss_fn or base_loss,
         forward_fn=fwd,
         grad_fn=grad_fn,
+        eval_loss_fn=None if loss_fn is not None else eval_loss,
     )
