@@ -1,0 +1,165 @@
+"""Offline corpus tokenizer: raw text -> the indexed dataset the train
+driver consumes (``<prefix>.bin`` + ``<prefix>.idx.npy``).
+
+Counterpart of the reference's Megatron preprocessing capability
+(site_package/megatron/training/tokenizer/ consumed by
+tools/preprocess_data.py in upstream Megatron): the reference vendors its
+tokenizers so ``--data_path`` can consume raw corpora; here tokenization is
+an explicit offline step and the training contract is the pre-tokenized
+int32 stream (data/dataset.py on-disk format).
+
+Tokenizers:
+  - ``bytes``               UTF-8 byte-level, vocab 256 (+257 with --append-eod:
+                            id 256 is EOD). Zero dependencies, deterministic.
+  - anything else           passed to ``transformers.AutoTokenizer
+                            .from_pretrained`` (a local directory works
+                            offline; a hub name needs network).
+
+Document segmentation (``--doc-sep``):
+  - ``line``        one document per non-empty input line (default; the jsonl
+                    -> one-text-per-line shape Megatron preprocessing uses)
+  - ``blank-line``  documents separated by blank lines (paragraph corpora)
+  - ``file``        each input file is one document
+
+CLI:
+  python -m galvatron_tpu.tools.tokenize_corpus \\
+      --input corpus_a.txt corpus_b.txt --output /data/corpus \\
+      --tokenizer bytes --append-eod
+
+The resulting prefix feeds ``--data_path /data/corpus``, or a weighted blend
+``--data_path "0.7 /data/a 0.3 /data/b"`` (data/dataset.py parse_blend).
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Iterator, List, Sequence
+
+
+class ByteTokenizer:
+    """UTF-8 byte-level tokenizer: ids 0..255, EOD = 256."""
+
+    vocab_size = 256
+    eod_id = 256
+
+    def encode(self, text: str) -> List[int]:
+        return list(text.encode("utf-8"))
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return bytes(i for i in ids if i < 256).decode("utf-8", errors="replace")
+
+
+class HFTokenizer:
+    """transformers.AutoTokenizer adapter (EOD = its eos token)."""
+
+    def __init__(self, name_or_path: str):
+        from transformers import AutoTokenizer
+
+        self.tok = AutoTokenizer.from_pretrained(name_or_path)
+        self.vocab_size = len(self.tok)
+        self.eod_id = self.tok.eos_token_id
+        if self.eod_id is None:
+            self.eod_id = self.tok.pad_token_id
+
+    def encode(self, text: str) -> List[int]:
+        return self.tok.encode(text, add_special_tokens=False)
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self.tok.decode(list(ids))
+
+
+def get_tokenizer(name: str):
+    return ByteTokenizer() if name == "bytes" else HFTokenizer(name)
+
+
+def iter_documents(paths: Sequence[str], doc_sep: str) -> Iterator[str]:
+    """Yield document texts from the input files per the segmentation mode."""
+    for path in paths:
+        with open(path, encoding="utf-8") as f:
+            if doc_sep == "file":
+                text = f.read().strip()
+                if text:
+                    yield text
+            elif doc_sep == "line":
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        yield line
+            elif doc_sep == "blank-line":
+                buf: List[str] = []
+                for line in f:
+                    if line.strip():
+                        buf.append(line.rstrip("\n"))
+                    elif buf:
+                        yield "\n".join(buf)
+                        buf = []
+                if buf:
+                    yield "\n".join(buf)
+            else:
+                raise ValueError("unknown --doc-sep %r" % doc_sep)
+
+
+def tokenize_corpus(
+    inputs: Sequence[str],
+    output_prefix: str,
+    tokenizer="bytes",
+    doc_sep: str = "line",
+    append_eod: bool = False,
+) -> dict:
+    """Tokenize input text files into <output_prefix>.bin/.idx.npy; returns
+    {n_docs, n_tokens, vocab_size} (vocab_size includes the EOD id when
+    --append-eod grows it past the tokenizer's own table, as the byte
+    tokenizer's does)."""
+    import numpy as np
+
+    tok = get_tokenizer(tokenizer) if isinstance(tokenizer, str) else tokenizer
+    if append_eod and tok.eod_id is None:
+        raise ValueError(
+            "--append-eod requested but the tokenizer has no EOD id "
+            "(no eos or pad token); pick another tokenizer or drop the flag"
+        )
+    # stream documents straight to the .bin (a pretraining corpus held as
+    # Python int lists costs ~28 bytes/token and OOMs; the upstream Megatron
+    # preprocessor this mirrors also streams), accumulating only offsets
+    offsets = [0]
+    with open(output_prefix + ".bin", "wb") as f:
+        for text in iter_documents(inputs, doc_sep):
+            ids = tok.encode(text)
+            if not ids:
+                continue
+            if append_eod:
+                ids = list(ids) + [tok.eod_id]
+            np.asarray(ids, np.int32).tofile(f)
+            offsets.append(offsets[-1] + len(ids))
+    if len(offsets) == 1:
+        raise ValueError("no non-empty documents found in %r" % list(inputs))
+    np.save(output_prefix + ".idx.npy", np.asarray(offsets, np.int64))
+    vocab = max(tok.vocab_size, (tok.eod_id + 1) if append_eod else 0)
+    return {"n_docs": len(offsets) - 1, "n_tokens": offsets[-1], "vocab_size": vocab}
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        "galvatron_tpu corpus tokenizer",
+        description="raw text -> <prefix>.bin/.idx.npy for --data_path",
+    )
+    p.add_argument("--input", nargs="+", required=True, help="input text files")
+    p.add_argument("--output", required=True, help="output dataset prefix")
+    p.add_argument("--tokenizer", default="bytes",
+                   help="'bytes' or a transformers AutoTokenizer name/path")
+    p.add_argument("--doc-sep", default="line",
+                   choices=("line", "blank-line", "file"))
+    p.add_argument("--append-eod", action="store_true",
+                   help="append the tokenizer's EOD id to every document")
+    a = p.parse_args(argv)
+    stats = tokenize_corpus(a.input, a.output, a.tokenizer, a.doc_sep, a.append_eod)
+    print(
+        "wrote %s.bin/.idx.npy: %d docs, %d tokens (vocab %d) — train with "
+        "--data_path %s and --vocab_size >= %d"
+        % (a.output, stats["n_docs"], stats["n_tokens"], stats["vocab_size"],
+           a.output, stats["vocab_size"])
+    )
+
+
+if __name__ == "__main__":
+    main()
