@@ -120,20 +120,33 @@ def tokenize_corpus(
         )
     # stream documents straight to the .bin (a pretraining corpus held as
     # Python int lists costs ~28 bytes/token and OOMs; the upstream Megatron
-    # preprocessor this mirrors also streams), accumulating only offsets
+    # preprocessor this mirrors also streams), accumulating only offsets.
+    # Stream to a temp file and drop any stale index FIRST: a mid-run failure
+    # must never leave a truncated .bin silently pairing with an old .idx.npy
+    import os
+
+    idx_path = output_prefix + ".idx.npy"
+    if os.path.exists(idx_path):
+        os.remove(idx_path)
+    tmp_bin = output_prefix + ".bin.tmp"
     offsets = [0]
-    with open(output_prefix + ".bin", "wb") as f:
-        for text in iter_documents(inputs, doc_sep):
-            ids = tok.encode(text)
-            if not ids:
-                continue
-            if append_eod:
-                ids = list(ids) + [tok.eod_id]
-            np.asarray(ids, np.int32).tofile(f)
-            offsets.append(offsets[-1] + len(ids))
-    if len(offsets) == 1:
-        raise ValueError("no non-empty documents found in %r" % list(inputs))
-    np.save(output_prefix + ".idx.npy", np.asarray(offsets, np.int64))
+    try:
+        with open(tmp_bin, "wb") as f:
+            for text in iter_documents(inputs, doc_sep):
+                ids = tok.encode(text)
+                if not ids:
+                    continue
+                if append_eod:
+                    ids = list(ids) + [tok.eod_id]
+                np.asarray(ids, np.int32).tofile(f)
+                offsets.append(offsets[-1] + len(ids))
+        if len(offsets) == 1:
+            raise ValueError("no non-empty documents found in %r" % list(inputs))
+        os.replace(tmp_bin, output_prefix + ".bin")
+    finally:
+        if os.path.exists(tmp_bin):
+            os.remove(tmp_bin)
+    np.save(idx_path, np.asarray(offsets, np.int64))
     vocab = max(tok.vocab_size, (tok.eod_id + 1) if append_eod else 0)
     return {"n_docs": len(offsets) - 1, "n_tokens": offsets[-1], "vocab_size": vocab}
 

@@ -73,3 +73,18 @@ def test_append_eod_requires_eod_id(tmp_path):
     txt.write_text("hello\n", encoding="utf-8")
     with pytest.raises(ValueError, match="no EOD id"):
         tokenize_corpus([str(txt)], str(tmp_path / "o"), NoEod(), append_eod=True)
+
+
+def test_failed_rerun_never_pairs_stale_index(tmp_path):
+    """A failed re-tokenization at an existing prefix must not leave a stale
+    .idx.npy pairing with a partial .bin — the dataset should fail loudly."""
+    from galvatron_tpu.data.dataset import IndexedDataset
+
+    txt = tmp_path / "a.txt"
+    txt.write_text("hello world\n", encoding="utf-8")
+    prefix = str(tmp_path / "ds")
+    tokenize_corpus([str(txt)], prefix)
+    with pytest.raises(FileNotFoundError):
+        tokenize_corpus([str(txt), str(tmp_path / "missing.txt")], prefix)
+    with pytest.raises(FileNotFoundError):
+        IndexedDataset(prefix)
