@@ -286,7 +286,10 @@ def layer_forward(
             bias=attn_bias,
         )
     else:
-        attn = core_attention(q, k, v, causal=cfg.causal, bias=attn_bias, impl=cfg.attn_impl)
+        # the generic tree's attn_bias is always padding_attn_bias output, so
+        # the flash path may lower it to segment ids instead of falling back
+        attn = core_attention(q, k, v, causal=cfg.causal, bias=attn_bias,
+                              impl=cfg.attn_impl, bias_type="key_padding")
     attn = attn.reshape(attn.shape[0], attn.shape[1], cfg.num_heads * cfg.head_dim)
     o = _dense(attn, p["wo"], dtype)
     if mesh is not None and axes is not None:

@@ -80,15 +80,31 @@ def _flash_block_sizes(sq: int, sk: int):
     )
 
 
-def _pallas_flash(q, k, v, *, causal: bool, sm_scale: float):
+def _pallas_flash(q, k, v, *, causal: bool, sm_scale: float, segment_ids=None):
     from jax.experimental.pallas.ops.tpu.flash_attention import flash_attention
 
     qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
     out = flash_attention(
-        qt, kt, vt, causal=causal, sm_scale=sm_scale,
+        qt, kt, vt, segment_ids=segment_ids, causal=causal, sm_scale=sm_scale,
         block_sizes=_flash_block_sizes(q.shape[1], k.shape[1]),
     )
     return out.transpose(0, 2, 1, 3)
+
+
+def padding_bias_to_segment_ids(bias: jax.Array):
+    """(B, 1, 1, Sk) additive 0/-1e9 key-padding bias -> flash SegmentIds.
+
+    Valid tokens get segment 1, padded tokens segment 0; the kernel only
+    attends within equal segments, which reproduces the padding semantics
+    exactly on valid rows (valid q x valid k see bias 0, padded keys are
+    excluded). Padded QUERY rows attend within the pad segment instead of
+    over valid keys — their outputs are garbage under both schemes and are
+    masked downstream (the same contract as the reference's varlen flash,
+    transformer.py:432-510, which drops padded rows entirely)."""
+    from jax.experimental.pallas.ops.tpu.flash_attention import SegmentIds
+
+    valid = (bias[:, 0, 0, :] > -1e8).astype(jnp.int32)  # (B, Sk)
+    return SegmentIds(q=valid, kv=valid)
 
 
 def core_attention(
@@ -100,9 +116,18 @@ def core_attention(
     sm_scale: Optional[float] = None,
     bias: Optional[jax.Array] = None,
     impl: str = "auto",
+    bias_type: str = "additive",
 ) -> jax.Array:
     """Multi-head attention on (B, S, nh, hd) tensors (kv may have fewer heads:
-    GQA is expanded here)."""
+    GQA is expanded here). bias_type="key_padding" declares `bias` to be the
+    (B, 1, 1, Sk) 0/-1e9 key-padding bias from padding_attn_bias **of a
+    SELF-attention call** (the same padding applies to queries and keys —
+    the segment-id lowering reuses the key mask for the query side, which is
+    wrong for equal-length cross-attention with different q/kv padding; use
+    the default bias_type there). The flash path then lowers it to segment
+    ids instead of falling back to the O(S^2) XLA path (the reference keeps
+    varlen flash for padded batches, transformer.py:432-510); a generic
+    additive bias (T5 relative positions) still falls back."""
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     if k.shape[2] != q.shape[2]:
@@ -110,12 +135,24 @@ def core_attention(
         n_rep = q.shape[2] // k.shape[2]
         k = repeat_kv(k, n_rep)
         v = repeat_kv(v, n_rep)
+    # a key-padding bias may ride the flash path as segment ids — but only at
+    # kernel-tileable shapes (block sizes must divide seq in multiples of
+    # 128); anything else keeps the XLA fallback, including on the explicit
+    # impl="flash" families (gpt_fa/llama_fa), which previously fell back for
+    # EVERY bias and must not start crashing on untileable padded batches
+    seg_flash_ok = (
+        bias is not None and bias_type == "key_padding"
+        and bias.ndim == 4 and bias.shape[1] == 1 and bias.shape[2] == 1
+        and bias.shape[3] == k.shape[1] and q.shape[1] == k.shape[1]
+        and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0
+    )
     if impl == "auto":
         # the pallas kernel is TPU-only ("axon" is the tunnelled TPU backend)
         on_tpu = jax.default_backend() in ("tpu", "axon")
         # pallas flash path needs seq/head tiling-friendly shapes
         ok_shapes = (
-            q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0 and q.shape[3] >= 128 and bias is None
+            q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0 and q.shape[3] >= 128
+            and (bias is None or seg_flash_ok)
         )
         # measured on the bench chip with the tuned 512x512 block sizes
         # (_flash_block_sizes): flash beats XLA's fused attention at every
@@ -124,11 +161,13 @@ def core_attention(
         # (b, nh, s, s) fp32 logits.
         impl = "flash" if (on_tpu and ok_shapes) else "xla"
     if impl == "flash":
-        if bias is not None:
-            # the pallas flash kernel takes no additive bias; fall back rather
-            # than silently dropping a padding mask
+        if bias is not None and not seg_flash_ok:
+            # the pallas flash kernel takes no generic additive bias; fall
+            # back rather than silently dropping it
             return _xla_attention(q, k, v, causal=causal, sm_scale=sm_scale, bias=bias)
-        return _pallas_flash(q, k, v, causal=causal, sm_scale=sm_scale)
+        seg = padding_bias_to_segment_ids(bias) if bias is not None else None
+        return _pallas_flash(q, k, v, causal=causal, sm_scale=sm_scale,
+                             segment_ids=seg)
     if impl == "xla":
         return _xla_attention(q, k, v, causal=causal, sm_scale=sm_scale, bias=bias)
     raise ValueError("unknown attention impl %r" % impl)

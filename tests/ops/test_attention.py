@@ -284,3 +284,90 @@ def test_ring_custom_vjp_bias_grad_with_tp_sharded_heads(devices8):
     g_a = jax.grad(lambda bb: loss(bb, False))(bias)
     np.testing.assert_allclose(np.asarray(g_c), np.asarray(g_a),
                                atol=2e-4, rtol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_segment_ids_match_xla_padding(causal):
+    """Padded-mask flash (VERDICT r4 item 3): the key-padding bias lowers to
+    segment ids on the flash path instead of the O(S^2) XLA fallback; kernel
+    run in pallas interpret mode, compared to _xla_attention with the
+    additive bias on the valid query rows (padded rows are garbage under
+    both schemes and masked downstream)."""
+    import jax.experimental.pallas.tpu as pltpu
+
+    from galvatron_tpu.ops.attention import (
+        _pallas_flash,
+        _xla_attention,
+        padding_bias_to_segment_ids,
+    )
+
+    b, s, nh, hd = 2, 256, 2, 128
+    q, k, v = _rand_qkv(jax.random.PRNGKey(31), b=b, s=s, nh=nh, hd=hd)
+    mask = np.ones((b, s), np.float32)
+    mask[0, -64:] = 0.0
+    mask[1, -128:] = 0.0
+    bias = jnp.asarray((1.0 - mask)[:, None, None, :] * -1e9)
+    seg = padding_bias_to_segment_ids(bias)
+    np.testing.assert_array_equal(np.asarray(seg.kv), mask.astype(np.int32))
+    with pltpu.force_tpu_interpret_mode():
+        out_f = _pallas_flash(q, k, v, causal=causal, sm_scale=hd**-0.5,
+                              segment_ids=seg)
+    out_x = _xla_attention(q, k, v, causal=causal, sm_scale=hd**-0.5, bias=bias)
+    valid = np.asarray(mask) > 0
+    np.testing.assert_allclose(np.asarray(out_f)[valid], np.asarray(out_x)[valid],
+                               atol=3e-5)
+
+
+def test_core_attention_padding_dispatch_stays_flash_eligible():
+    """Dispatch logic: a key-padding bias keeps flash eligibility (lowered to
+    segment ids) while a generic additive bias (T5 relative positions) and
+    cross-shaped biases still fall back to XLA."""
+    from galvatron_tpu.ops import attention as A
+
+    b, s, nh, hd = 2, 256, 2, 128
+    q, k, v = _rand_qkv(jax.random.PRNGKey(32), b=b, s=s, nh=nh, hd=hd)
+    mask = np.ones((b, s), np.float32)
+    mask[:, -64:] = 0.0
+    pad_bias = jnp.asarray((1.0 - mask)[:, None, None, :] * -1e9)
+
+    calls = []
+    orig = A._pallas_flash
+
+    def spy(q_, k_, v_, **kw):
+        calls.append(kw.get("segment_ids") is not None)
+        import jax.experimental.pallas.tpu as pltpu
+
+        with pltpu.force_tpu_interpret_mode():
+            return orig(q_, k_, v_, **kw)
+
+    import unittest.mock as mock
+
+    with mock.patch.object(A, "_pallas_flash", spy), \
+         mock.patch.object(jax, "default_backend", lambda: "tpu"):
+        out = A.core_attention(q, k, v, causal=False, bias=pad_bias,
+                               bias_type="key_padding")
+        # generic additive bias: must NOT hit the kernel
+        rel = jnp.zeros((1, nh, s, s), jnp.float32)
+        A.core_attention(q, k, v, causal=False, bias=rel)
+    assert calls == [True], calls
+    ref = A._xla_attention(q, k, v, causal=False, sm_scale=hd**-0.5, bias=pad_bias)
+    valid = np.asarray(mask) > 0
+    np.testing.assert_allclose(np.asarray(out)[valid], np.asarray(ref)[valid],
+                               atol=3e-5)
+
+
+def test_explicit_flash_with_untileable_padded_batch_falls_back():
+    """impl="flash" families (gpt_fa/llama_fa) with a padded batch at a seq
+    the kernel cannot tile (not a multiple of 128) must keep the XLA
+    fallback, not crash in the kernel."""
+    from galvatron_tpu.ops import attention as A
+
+    b, s, nh, hd = 2, 96, 2, 128
+    q, k, v = _rand_qkv(jax.random.PRNGKey(33), b=b, s=s, nh=nh, hd=hd)
+    mask = np.ones((b, s), np.float32)
+    mask[:, -16:] = 0.0
+    bias = jnp.asarray((1.0 - mask)[:, None, None, :] * -1e9)
+    out = A.core_attention(q, k, v, causal=False, bias=bias, impl="flash",
+                           bias_type="key_padding")
+    ref = A._xla_attention(q, k, v, causal=False, sm_scale=hd**-0.5, bias=bias)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5)
