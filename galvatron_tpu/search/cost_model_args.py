@@ -76,3 +76,43 @@ def default_optimal_chunk_func(local_bsz, strategy, mbsz, min_tp):
     if mbsz <= 0:
         return 1
     return max(1, int(math.ceil(local_bsz / mbsz)))
+
+
+def parse_hardware_profiles(
+    allreduce_bandwidth_config: Optional[Dict[str, Any]] = None,
+    p2p_bandwidth_config: Optional[Dict[str, Any]] = None,
+    overlap_config: Optional[Dict[str, Any]] = None,
+    sp_time_config: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Hardware-profile JSONs -> cost-model coefficient dicts (the ONE
+    mapping both the search engine and profiler/validate consume: schemas
+    match the reference hardware profiler, 'allreduce_size_%d_consec_%d' in
+    GB/s, 'pp_size_%d', 'overlap_coe').
+
+    Returns {comm_coe_dict (ms/MB), p2p_coe_dict (ms/MB per pp degree),
+    overlap_coe, allreduce_dict, all2all_dict}."""
+    comm_coe_dict: Dict[str, float] = {}
+    for key, gbps in (allreduce_bandwidth_config or {}).items():
+        if not key.startswith("allreduce_size_"):
+            continue
+        size_s, consec_s = key[len("allreduce_size_"):].split("_consec_")
+        tag = (
+            size_s
+            if int(consec_s) == 1
+            and ("allreduce_size_%s_consec_0" % size_s) not in allreduce_bandwidth_config
+            else "%s_%s" % (size_s, consec_s)
+        )
+        # ms per MB = 1e3 / (GB/s * 1024)
+        comm_coe_dict[tag] = 1000.0 / (float(gbps) * 1024.0)
+    comm_coe_dict.setdefault("1", 0.0)
+    p2p_coe_dict = {
+        int(k[len("pp_size_"):]): 1000.0 / (float(v) * 1024.0)
+        for k, v in (p2p_bandwidth_config or {}).items() if k.startswith("pp_size_")
+    }
+    return {
+        "comm_coe_dict": comm_coe_dict,
+        "p2p_coe_dict": p2p_coe_dict,
+        "overlap_coe": float((overlap_config or {}).get("overlap_coe", 1.1)),
+        "allreduce_dict": {int(k): v for k, v in ((sp_time_config or {}).get("allreduce", {})).items()},
+        "all2all_dict": {int(k): v for k, v in ((sp_time_config or {}).get("all2all", {})).items()},
+    }

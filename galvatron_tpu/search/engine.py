@@ -266,27 +266,19 @@ class GalvatronSearchEngine:
     ):
         """Hardware JSONs (schemas match the reference hardware profiler:
         allreduce_bandwidth_*.json keys 'allreduce_size_%d_consec_%d' in GB/s;
-        p2p_bandwidth 'pp_size_%d'; overlap 'overlap_coe')."""
-        self.comm_coe_dict = {}
-        for key, gbps in allreduce_bandwidth_config.items():
-            if not key.startswith("allreduce_size_"):
-                continue
-            rest = key[len("allreduce_size_"):]
-            size_s, consec_s = rest.split("_consec_")
-            tag = size_s if int(consec_s) == 1 and ("allreduce_size_%s_consec_0" % size_s) not in allreduce_bandwidth_config else "%s_%s" % (size_s, consec_s)
-            # ms per MB = 1e3 / (GB/s * 1024)
-            self.comm_coe_dict[tag] = 1000.0 / (float(gbps) * 1024.0)
-        self.comm_coe_dict.setdefault("1", 0.0)
-        self.p2p_coe_dict = {}
-        if p2p_bandwidth_config:
-            for key, gbps in p2p_bandwidth_config.items():
-                if key.startswith("pp_size_"):
-                    self.p2p_coe_dict[int(key[len("pp_size_"):])] = 1000.0 / (float(gbps) * 1024.0)
-        self.overlap_coe = float((overlap_config or {}).get("overlap_coe", 1.1))
-        self.allreduce_dict = (sp_time_config or {}).get("allreduce", {})
-        self.all2all_dict = (sp_time_config or {}).get("all2all", {})
-        self.allreduce_dict = {int(k): v for k, v in self.allreduce_dict.items()}
-        self.all2all_dict = {int(k): v for k, v in self.all2all_dict.items()}
+        p2p_bandwidth 'pp_size_%d'; overlap 'overlap_coe'). Parsing is shared
+        with profiler/validate via parse_hardware_profiles."""
+        from galvatron_tpu.search.cost_model_args import parse_hardware_profiles
+
+        hwp = parse_hardware_profiles(
+            allreduce_bandwidth_config, p2p_bandwidth_config,
+            overlap_config, sp_time_config,
+        )
+        self.comm_coe_dict = hwp["comm_coe_dict"]
+        self.p2p_coe_dict = hwp["p2p_coe_dict"]
+        self.overlap_coe = hwp["overlap_coe"]
+        self.allreduce_dict = hwp["allreduce_dict"]
+        self.all2all_dict = hwp["all2all_dict"]
 
     # ------------------------------------------------------------- arg bundles
     def _bundles(self, chunks: Optional[int]):

