@@ -167,16 +167,16 @@ class TimeValidation:
         return self.measured_ms / max(self.predicted_ms, 1e-9)
 
 
-def _hw_dicts(hw: Dict[str, Dict]):
-    """HardwareProfiler.profile_all output -> (comm_coe_dict ms/MB,
-    p2p_coe_dict ms/MB, overlap_coe), via the SAME parser the search engine
-    uses (cost_model_args.parse_hardware_profiles)."""
+def _hw_dicts(hw: Dict[str, Dict]) -> Dict[str, Any]:
+    """HardwareProfiler.profile_all output -> the full coefficient bundle
+    (comm_coe_dict, p2p_coe_dict, overlap_coe, allreduce_dict, all2all_dict),
+    via the SAME parser the search engine uses
+    (cost_model_args.parse_hardware_profiles)."""
     from galvatron_tpu.search.cost_model_args import parse_hardware_profiles
 
-    hwp = parse_hardware_profiles(
+    return parse_hardware_profiles(
         hw.get("allreduce"), hw.get("p2p"), hw.get("overlap"), hw.get("sp"),
     )
-    return hwp["comm_coe_dict"], hwp["p2p_coe_dict"], hwp["overlap_coe"]
 
 
 def predict_step_time_ms(
@@ -198,7 +198,7 @@ def predict_step_time_ms(
     )
 
     n_layers = len(hp.layers)
-    comm, p2p, coe = _hw_dicts(hw)
+    hwp = _hw_dicts(hw)
     ma = ModelArgs(
         parameter_size=memory_config["layertype_0"]["parameter_size"],
         seq_length=seq_len, hidden_size=hidden, layer_num=n_layers,
@@ -215,8 +215,9 @@ def predict_step_time_ms(
     from galvatron_tpu.search.cost_model_args import ProfileHardwareArgs
 
     pha = ProfileHardwareArgs(
-        comm_coe_dict=comm, dp_overlap_coe=coe, bct_overlap_coe=coe,
-        p2p_comm_coe_dict=p2p,
+        comm_coe_dict=hwp["comm_coe_dict"], dp_overlap_coe=hwp["overlap_coe"],
+        bct_overlap_coe=hwp["overlap_coe"], p2p_comm_coe_dict=hwp["p2p_coe_dict"],
+        allreduce_dict=hwp["allreduce_dict"], all2all_dict=hwp["all2all_dict"],
     )
     max_tp = max(s.tp for s in hp.layers)
     otc = OtherTimeCostModel(
@@ -225,6 +226,7 @@ def predict_step_time_ms(
         # the number the search actually scored
         mbsz=max(1, hp.global_bsz // hp.world_size),
         pp_deg=hp.pp, world_size=hp.world_size, vsp=hp.vocab_sp,
+        embed_sdp=bool(getattr(hp, "embed_sdp", 0)),
         min_tp=1, max_tp=max(max_tp, hp.vocab_tp),
         sequence_length_list=[seq_len], model_args=ma, train_args=ta,
         parallel_args=pa, profile_model_args=pma, profile_hardware_args=pha,

@@ -1,40 +1,39 @@
 """Sweep pallas flash-attention BACKWARD block sizes on the real chip
-(VERDICT r3 item 1: the forward was swept in round 3; the backward kept the
-forward's blocks untuned). Times jax.grad through the kernel with K
-iterations inside one jitted scan so tunnel dispatch amortises.
+(VERDICT r3 item 1 / r4 item 2: the forward was swept in round 3; the
+backward keeps the forward's blocks until this records a winner). Times
+jax.grad through the kernel with K iterations inside one jitted scan so
+tunnel dispatch amortises.
+
+Wedge-tolerant (the axon endpoint can hang indefinitely): every config runs
+in a fresh subprocess with a hard timeout, and results stream to
+scripts/flash_bwd_sweep_results.json after each config — a wedge mid-sweep
+keeps everything measured so far.
 
 Usage: python scripts/sweep_flash_bwd.py
 """
 
 import itertools
+import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
-import jax
-import jax.numpy as jnp
-
-from galvatron_tpu.ops import attention as A
-
 BATCH, SEQ, HEADS, HD = 4, 2048, 32, 128
 K = 8
-
-
-def timed(fn, *args, iters=3):
-    def sync(x):
-        return float(jnp.sum(jax.tree.leaves(x)[0].astype(jnp.float32)))
-
-    sync(fn(*args))
-    ts = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        sync(fn(*args))
-        ts.append(time.perf_counter() - t0)
-    return float(np.min(ts))
+RESULTS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "flash_bwd_sweep_results.json")
+CONFIG_TIMEOUT_S = 240.0
 
 
 def bwd_time(block_overrides):
     """fwd+bwd time per call with the given dkv/dq block sizes (ms)."""
+    import jax
+    import jax.numpy as jnp
+
+    from galvatron_tpu.ops import attention as A
     from jax.experimental.pallas.ops.tpu.flash_attention import BlockSizes
 
     orig = A._flash_block_sizes
@@ -62,38 +61,85 @@ def bwd_time(block_overrides):
         def run(c):
             def body(cc, _):
                 return cc - 1e-6 * jax.grad(attn_loss)(cc), ()
+
             out, _ = jax.lax.scan(body, c, None, length=K)
             return out
 
-        return timed(run, q) / K * 1e3
+        def sync(x):
+            return float(jnp.sum(x.astype(jnp.float32)))
+
+        sync(run(q))
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            sync(run(q))
+            ts.append(time.perf_counter() - t0)
+        return float(np.min(ts)) / K * 1e3
     finally:
         A._flash_block_sizes = orig
 
 
-def main():
-    print("device:", jax.devices()[0].device_kind, flush=True)
-    base = bwd_time({})
-    print("baseline (dkv/dq = fwd 1024q/512k): %.2f ms" % base, flush=True)
-    results = {"base_1024_512": base}
-    grid_q = [256, 512, 1024]
-    grid_k = [256, 512, 1024]
-    for bq, bk in itertools.product(grid_q, grid_k):
+def _grid():
+    configs = [("base_1024_512", {})]
+    for bq, bk in itertools.product([256, 512, 1024], [256, 512, 1024]):
         if bq == 1024 and bk == 512:
             continue
-        ov = {
+        configs.append(("q%d_k%d" % (bq, bk), {
             "block_q_major_dkv": bq, "block_q_dkv": bq,
             "block_k_major_dkv": bk, "block_k_dkv": bk,
             "block_q_dq": bq, "block_k_major_dq": bk, "block_k_dq": bk,
-        }
+        }))
+    return configs
+
+
+def main():
+    if os.environ.get("GALVATRON_SWEEP_CONFIG"):
+        name = os.environ["GALVATRON_SWEEP_CONFIG"]
+        overrides = dict(_grid())[name]
+        print(json.dumps({"name": name, "ms": bwd_time(overrides)}))
+        return
+
+    results = {}
+    if os.path.exists(RESULTS_PATH):
         try:
-            t = bwd_time(ov)
-        except Exception as e:
-            print("dkv/dq q%d k%d: FAIL %s" % (bq, bk, str(e)[:80]), flush=True)
+            results = json.load(open(RESULTS_PATH)).get("results", {})
+            print("resuming; already have %d results" % len(results), flush=True)
+        except (json.JSONDecodeError, OSError) as e:
+            print("results file unreadable (%s); starting fresh" % e, flush=True)
+    for name, _ in _grid():
+        if name in results:
             continue
-        results["q%d_k%d" % (bq, bk)] = t
-        print("dkv/dq q%d k%d: %.2f ms" % (bq, bk, t), flush=True)
-    best = min(results, key=results.get)
-    print("BEST: %s = %.2f ms (baseline %.2f)" % (best, results[best], base))
+        env = dict(os.environ, GALVATRON_SWEEP_CONFIG=name)
+        try:
+            p = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)], env=env,
+                capture_output=True, text=True, timeout=CONFIG_TIMEOUT_S,
+            )
+        except subprocess.TimeoutExpired:
+            print("%s: TIMEOUT (tunnel wedge?)" % name, flush=True)
+            continue
+        line = next((ln for ln in reversed(p.stdout.strip().splitlines())
+                     if ln.startswith("{")), None)
+        if p.returncode != 0 or line is None:
+            print("%s: FAIL rc=%d %s" % (name, p.returncode,
+                                         (p.stderr or "").strip()[-120:]), flush=True)
+            continue
+        results[name] = json.loads(line)["ms"]
+        print("%s: %.2f ms" % (name, results[name]), flush=True)
+        best = min(results, key=results.get)
+        # atomic write: a kill mid-dump must not corrupt the resume file
+        tmp = RESULTS_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"shapes": dict(batch=BATCH, seq=SEQ, heads=HEADS, hd=HD),
+                       "steps_per_call": K, "results": results, "best": best},
+                      f, indent=1)
+        os.replace(tmp, RESULTS_PATH)
+    if results:
+        best = min(results, key=results.get)
+        print("BEST: %s = %.2f ms (baseline %s)"
+              % (best, results[best], results.get("base_1024_512")))
+    else:
+        print("no results — tunnel down for every config?")
 
 
 if __name__ == "__main__":

@@ -144,7 +144,8 @@ def test_split_prices_comm_into_owning_slot(memory_config, time_config,
         TrainArgs,
     )
 
-    comm, p2p, coe = _hw_dicts(hw_profiles)
+    hwp = _hw_dicts(hw_profiles)
+    comm, p2p, coe = hwp["comm_coe_dict"], hwp["p2p_coe_dict"], hwp["overlap_coe"]
     kw = dict(
         global_batch_size=8,
         model_args=ModelArgs(
