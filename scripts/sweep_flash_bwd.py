@@ -21,10 +21,13 @@ import time
 
 import numpy as np
 
-BATCH, SEQ, HEADS, HD = 4, 2048, 32, 128
-K = 8
-RESULTS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "flash_bwd_sweep_results.json")
+SMOKE = bool(os.environ.get("GALVATRON_SWEEP_SMOKE"))
+BATCH, SEQ, HEADS, HD = (1, 256, 2, 128) if SMOKE else (4, 2048, 32, 128)
+K = 1 if SMOKE else 8
+RESULTS_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "flash_bwd_sweep_results%s.json" % ("_smoke" if SMOKE else ""),
+)
 CONFIG_TIMEOUT_S = 240.0
 
 
@@ -50,6 +53,17 @@ def bwd_time(block_overrides):
                    for k, v in block_overrides.items()})
         return BlockSizes(**kw)
 
+    import contextlib
+
+    # smoke mode exercises the sweep machinery off-chip (interpret-mode
+    # kernel; timings meaningless)
+    if jax.default_backend() in ("tpu", "axon"):
+        ctx = contextlib.nullcontext()
+    else:
+        import jax.experimental.pallas.tpu as pltpu
+
+        ctx = pltpu.force_tpu_interpret_mode()
+
     A._flash_block_sizes = patched
     try:
         q = jax.random.normal(jax.random.PRNGKey(2), (BATCH, SEQ, HEADS, HD), jnp.bfloat16)
@@ -68,71 +82,125 @@ def bwd_time(block_overrides):
         def sync(x):
             return float(jnp.sum(x.astype(jnp.float32)))
 
-        sync(run(q))
-        ts = []
-        for _ in range(3):
-            t0 = time.perf_counter()
+        with ctx:
             sync(run(q))
-            ts.append(time.perf_counter() - t0)
+            ts = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                sync(run(q))
+                ts.append(time.perf_counter() - t0)
         return float(np.min(ts)) / K * 1e3
     finally:
         A._flash_block_sizes = orig
 
 
 def _grid():
-    configs = [("base_1024_512", {})]
-    for bq, bk in itertools.product([256, 512, 1024], [256, 512, 1024]):
-        if bq == 1024 and bk == 512:
-            continue
-        configs.append(("q%d_k%d" % (bq, bk), {
+    def ov(bq, bk):
+        return {
             "block_q_major_dkv": bq, "block_q_dkv": bq,
             "block_k_major_dkv": bk, "block_k_dkv": bk,
             "block_q_dq": bq, "block_k_major_dq": bk, "block_k_dq": bk,
-        }))
+        }
+
+    configs = [("base_1024_512", {})]
+    if SMOKE:
+        # machinery check only: one override config (interpret mode is slow)
+        return configs + [("q256_k256", ov(256, 256))]
+    for bq, bk in itertools.product([256, 512, 1024], [256, 512, 1024]):
+        if bq == 1024 and bk == 512:
+            continue
+        configs.append(("q%d_k%d" % (bq, bk), ov(bq, bk)))
     return configs
 
 
 def main():
     if os.environ.get("GALVATRON_SWEEP_CONFIG"):
+        # honor an explicit non-axon JAX_PLATFORMS (CPU smoke): the axon
+        # plugin pins jax_platforms at registration and only config.update
+        # outranks it (same recipe as bench.py sections)
+        jp = os.environ.get("JAX_PLATFORMS")
+        if jp and "axon" not in jp:
+            import jax
+
+            jax.config.update("jax_platforms", jp)
         name = os.environ["GALVATRON_SWEEP_CONFIG"]
         overrides = dict(_grid())[name]
-        print(json.dumps({"name": name, "ms": bwd_time(overrides)}))
+        ms = bwd_time(overrides)
+        import jax
+
+        print(json.dumps({"name": name, "ms": ms,
+                          "device": jax.devices()[0].device_kind}))
         return
 
+    context = {"shapes": dict(batch=BATCH, seq=SEQ, heads=HEADS, hd=HD),
+               "steps_per_call": K}
     results = {}
     if os.path.exists(RESULTS_PATH):
         try:
-            results = json.load(open(RESULTS_PATH)).get("results", {})
-            print("resuming; already have %d results" % len(results), flush=True)
+            prev = json.load(open(RESULTS_PATH))
+            # only resume measurements taken under the SAME shapes/K: stale
+            # entries from other conditions must not compete for "best"
+            if all(prev.get(k) == v for k, v in context.items()):
+                results = prev.get("results", {})
+                print("resuming; already have %d results" % len(results), flush=True)
+            else:
+                print("results file is from different shapes/K; starting fresh",
+                      flush=True)
         except (json.JSONDecodeError, OSError) as e:
             print("results file unreadable (%s); starting fresh" % e, flush=True)
     for name, _ in _grid():
         if name in results:
             continue
         env = dict(os.environ, GALVATRON_SWEEP_CONFIG=name)
+        # children import galvatron_tpu; keep /root/.axon_site on the path or
+        # the axon backend fails to register (verify SKILL.md gotcha)
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        extra = [repo, "/root/.axon_site", env.get("PYTHONPATH", "")]
+        env["PYTHONPATH"] = ":".join(p for p in extra if p)
+        # own process group: a wedged child's tunnel helpers must die with it,
+        # or they squat the chip and wedge every later config (bench.py recipe)
+        p = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            start_new_session=True,
+        )
         try:
-            p = subprocess.run(
-                [sys.executable, os.path.abspath(__file__)], env=env,
-                capture_output=True, text=True, timeout=CONFIG_TIMEOUT_S,
-            )
+            out, err = p.communicate(timeout=CONFIG_TIMEOUT_S)
         except subprocess.TimeoutExpired:
+            try:
+                os.killpg(p.pid, 9)
+            except (OSError, ProcessLookupError):
+                p.kill()
+            try:
+                out, err = p.communicate(timeout=10.0)
+            except subprocess.TimeoutExpired:
+                out = ""
             print("%s: TIMEOUT (tunnel wedge?)" % name, flush=True)
             continue
-        line = next((ln for ln in reversed(p.stdout.strip().splitlines())
-                     if ln.startswith("{")), None)
-        if p.returncode != 0 or line is None:
-            print("%s: FAIL rc=%d %s" % (name, p.returncode,
-                                         (p.stderr or "").strip()[-120:]), flush=True)
+        # keep whatever was measured: a child that printed its JSON but died
+        # in tunnel teardown still counts (bench.py _extract_json semantics)
+        payload = None
+        for ln in reversed((out or "").strip().splitlines()):
+            ln = ln.strip()
+            if ln.startswith("{"):
+                try:
+                    payload = json.loads(ln)
+                except json.JSONDecodeError:
+                    pass
+                break
+        if payload is None:
+            print("%s: FAIL rc=%s %s" % (name, p.returncode,
+                                         (err or "").strip()[-120:]), flush=True)
             continue
-        results[name] = json.loads(line)["ms"]
-        print("%s: %.2f ms" % (name, results[name]), flush=True)
+        results[name] = payload["ms"]
+        print("%s: %.2f ms (device %s)" % (name, results[name],
+                                           payload.get("device", "?")), flush=True)
         best = min(results, key=results.get)
         # atomic write: a kill mid-dump must not corrupt the resume file
         tmp = RESULTS_PATH + ".tmp"
         with open(tmp, "w") as f:
-            json.dump({"shapes": dict(batch=BATCH, seq=SEQ, heads=HEADS, hd=HD),
-                       "steps_per_call": K, "results": results, "best": best},
-                      f, indent=1)
+            json.dump(dict(context, device=payload.get("device"),
+                           results=results, best=best), f, indent=1)
         os.replace(tmp, RESULTS_PATH)
     if results:
         best = min(results, key=results.get)
