@@ -18,7 +18,6 @@ path transposes to its (batch, heads, seq, head_dim) convention.
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax

@@ -19,7 +19,7 @@ with walltimed steps (requires a real device to be meaningful).
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Dict, Optional
+from typing import Any, Dict
 
 import numpy as np
 

@@ -18,8 +18,7 @@ and of `GalvatronModel.forward_backward` (:42-70). Here the assembly is:
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from functools import partial
+from dataclasses import dataclass
 from typing import Any, Callable, Dict, Optional
 
 import jax
@@ -31,7 +30,7 @@ from galvatron_tpu.config.strategy import HybridParallelConfig
 from galvatron_tpu.models import base as M
 from galvatron_tpu.parallel import spec as S
 from galvatron_tpu.parallel.mesh import build_mesh, layer_axes, vocab_axes
-from galvatron_tpu.runtime.optimizer import OptimizerArgs, get_optimizer_and_scheduler, opt_state_specs
+from galvatron_tpu.runtime.optimizer import opt_state_specs
 
 Params = Dict[str, Any]
 
