@@ -1,0 +1,113 @@
+"""Shared wedge-tolerant subprocess harness for the standalone benchmark
+orchestrators (bench.py, scripts/sweep_flash_bwd.py).
+
+The axon remote-compile endpoint can hang a child process indefinitely
+(BENCH_r04 rc=124), so both orchestrators run every measurement in a fresh
+subprocess and must agree on the recovery rules:
+
+  - children run in their OWN process group and are SIGKILLed as a unit on
+    timeout, so wedged tunnel helpers cannot squat the chip;
+  - a child that printed its result JSON but died in tunnel teardown still
+    counts as success;
+  - an explicit non-axon JAX_PLATFORMS is honored via jax.config.update
+    (the axon plugin pins jax_platforms at registration; the env var alone
+    does not win);
+  - off-TPU smoke runs execute pallas kernels in interpret mode.
+
+stdlib-only on the orchestrator side: importing this module must never touch
+jax (the whole point is that the parent cannot wedge)."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+from typing import Optional, Tuple
+
+
+def extract_json(stdout: Optional[str]) -> Optional[dict]:
+    """Last parseable {...} line of a child's stdout, else None."""
+    for line in reversed((stdout or "").strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                return None
+    return None
+
+
+def run_isolated(argv, env, timeout_s: float,
+                 on_spawn=None) -> Tuple[Optional[dict], Optional[int], str]:
+    """Run `argv` in its own process group with a hard timeout.
+
+    Returns (payload, returncode, stderr_tail): payload is the child's last
+    JSON stdout line (accepted EVEN IF the child exited non-zero — flaky
+    tunnel destructors must not discard a finished measurement); returncode
+    is None on timeout (the whole process group is SIGKILLed). `on_spawn`
+    receives the live Popen so a caller's watchdog can kill_group() it from
+    a signal handler."""
+    p = subprocess.Popen(
+        argv, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, start_new_session=True,
+    )
+    if on_spawn is not None:
+        on_spawn(p)
+    try:
+        out, err = p.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        kill_group(p)
+        try:
+            out, err = p.communicate(timeout=10.0)
+        except subprocess.TimeoutExpired:
+            out, err = "", ""
+        return extract_json(out), None, (err or "").strip()[-200:]
+    return extract_json(out), p.returncode, (err or "").strip()[-200:]
+
+
+def kill_group(p: subprocess.Popen) -> None:
+    """SIGKILL a child and its whole process group (tunnel helpers included)."""
+    if p.poll() is None:
+        try:
+            os.killpg(p.pid, signal.SIGKILL)
+        except (OSError, ProcessLookupError):
+            p.kill()
+
+
+def apply_jax_platforms_override() -> None:
+    """In a measurement CHILD: honor an explicit non-axon JAX_PLATFORMS.
+    Only jax.config.update outranks the axon plugin's pinned platforms."""
+    jp = os.environ.get("JAX_PLATFORMS")
+    if jp and "axon" not in jp:
+        import jax
+
+        jax.config.update("jax_platforms", jp)
+
+
+def interpret_ctx_factory():
+    """Context-manager factory for pallas kernels: native on TPU, interpret
+    mode elsewhere (CPU smoke runs — timings meaningless, path exercised).
+    Call once per timed region; generator-based contexts are single-use."""
+    import contextlib
+
+    import jax
+
+    if jax.default_backend() in ("tpu", "axon"):
+        return contextlib.nullcontext
+    import jax.experimental.pallas.tpu as pltpu
+
+    return pltpu.force_tpu_interpret_mode
+
+
+def child_pythonpath(env: dict, repo_root: str) -> str:
+    """PYTHONPATH for measurement children: the repo (so galvatron_tpu
+    imports) plus /root/.axon_site (or the axon backend fails to register —
+    see .claude/skills/verify/SKILL.md)."""
+    extra = [repo_root, "/root/.axon_site", env.get("PYTHONPATH", "")]
+    return ":".join(p for p in extra if p)
+
+
+if sys.version_info < (3, 9):  # pragma: no cover
+    raise RuntimeError("python >= 3.9 required")

@@ -32,9 +32,15 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
 import json
 import os
 import signal
-import subprocess
 import sys
 import time
+
+from _bench_util import (
+    apply_jax_platforms_override,
+    interpret_ctx_factory,
+    kill_group,
+    run_isolated,
+)
 
 REFERENCE_MS_PER_LAYER_PER_SAMPLE = 5.331
 
@@ -358,17 +364,7 @@ def section_masked_flash():
     f_xla = k_steps(lambda c: _xla_attention(c, k, v, causal=False, sm_scale=sc,
                                              bias=bias))
 
-    import contextlib
-
-    # CPU smoke runs interpret the kernel (timings meaningless but the
-    # section path is exercised); the real chip runs it natively
-    on_tpu = jax.default_backend() in ("tpu", "axon")
-    if on_tpu:
-        make_ctx = contextlib.nullcontext
-    else:
-        import jax.experimental.pallas.tpu as pltpu
-
-        make_ctx = pltpu.force_tpu_interpret_mode
+    make_ctx = interpret_ctx_factory()
 
     def t(fn):
         with make_ctx():
@@ -417,28 +413,20 @@ def _remaining():
 
 
 def _kill_active_child():
-    child = _ACTIVE_CHILD
-    if child is not None and child.poll() is None:
-        try:
-            os.killpg(child.pid, signal.SIGKILL)
-        except (OSError, ProcessLookupError):
-            child.kill()
-
-
-def _extract_json(stdout):
-    for line in reversed((stdout or "").strip().splitlines()):
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                return json.loads(line)
-            except json.JSONDecodeError:
-                return None
-    return None
+    if _ACTIVE_CHILD is not None:
+        kill_group(_ACTIVE_CHILD)
 
 
 def _run_section(name, errors, extra_env=None):
-    """Run one section in a fresh subprocess; one retry; None on failure."""
+    """Run one section via the shared wedge-tolerant harness (_bench_util):
+    fresh subprocess in its own process group, one retry; None on failure.
+    A child that printed its JSON but died in teardown still counts."""
     global _ACTIVE_CHILD
+
+    def on_spawn(p):
+        global _ACTIVE_CHILD
+        _ACTIVE_CHILD = p
+
     budget = SECTION_BUDGETS[name]
     for attempt in (1, 2):
         b = min(budget, _remaining() - 10.0)
@@ -448,37 +436,19 @@ def _run_section(name, errors, extra_env=None):
         env = dict(os.environ)
         env["GALVATRON_BENCH_SECTION"] = name
         env.update(extra_env or {})
-        # own process group so a wedged child (and any helpers) can be
-        # SIGKILLed as a unit, including from the watchdog
-        p = subprocess.Popen(
-            [sys.executable, os.path.abspath(__file__)],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-            text=True, start_new_session=True,
+        result, rc, err_tail = run_isolated(
+            [sys.executable, os.path.abspath(__file__)], env, b, on_spawn=on_spawn,
         )
-        _ACTIVE_CHILD = p
-        try:
-            out, err = p.communicate(timeout=b)
-        except subprocess.TimeoutExpired:
-            _kill_active_child()
-            try:
-                out, err = p.communicate(timeout=10.0)
-            except subprocess.TimeoutExpired:
-                out, err = "", ""
-            _ACTIVE_CHILD = None
-            errors[name] = "attempt %d: timeout after %.0fs (tunnel wedge?)" % (attempt, b)
-            continue
         _ACTIVE_CHILD = None
-        # keep whatever was measured: a child that printed its JSON but died
-        # in teardown (flaky tunnel destructors) still counts as success
-        result = _extract_json(out)
         if result is not None:
             errors.pop(name, None)
             return result
-        if p.returncode == 0:
+        if rc is None:
+            errors[name] = "attempt %d: timeout after %.0fs (tunnel wedge?)" % (attempt, b)
+        elif rc == 0:
             errors[name] = "attempt %d: no JSON in section output" % attempt
         else:
-            tail = (err or "").strip().splitlines()[-3:]
-            errors[name] = "attempt %d: rc=%d %s" % (attempt, p.returncode, " | ".join(tail)[:200])
+            errors[name] = "attempt %d: rc=%d %s" % (attempt, rc, err_tail)
     return None
 
 
@@ -540,14 +510,7 @@ def main():
 
 if __name__ == "__main__":
     if SECTION:
-        # honor an explicit non-axon JAX_PLATFORMS (CPU validation runs):
-        # the axon plugin pins jax_platforms at registration, and only
-        # config.update outranks it
-        _jp = os.environ.get("JAX_PLATFORMS")
-        if _jp and "axon" not in _jp:
-            import jax
-
-            jax.config.update("jax_platforms", _jp)
+        apply_jax_platforms_override()
         print(json.dumps(SECTIONS[SECTION]()))
     else:
         main()

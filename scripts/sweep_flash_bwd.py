@@ -15,11 +15,20 @@ Usage: python scripts/sweep_flash_bwd.py
 import itertools
 import json
 import os
-import subprocess
 import sys
 import time
 
 import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from _bench_util import (  # noqa: E402
+    apply_jax_platforms_override,
+    child_pythonpath,
+    interpret_ctx_factory,
+    run_isolated,
+)
 
 SMOKE = bool(os.environ.get("GALVATRON_SWEEP_SMOKE"))
 BATCH, SEQ, HEADS, HD = (1, 256, 2, 128) if SMOKE else (4, 2048, 32, 128)
@@ -53,16 +62,8 @@ def bwd_time(block_overrides):
                    for k, v in block_overrides.items()})
         return BlockSizes(**kw)
 
-    import contextlib
-
-    # smoke mode exercises the sweep machinery off-chip (interpret-mode
-    # kernel; timings meaningless)
-    if jax.default_backend() in ("tpu", "axon"):
-        ctx = contextlib.nullcontext()
-    else:
-        import jax.experimental.pallas.tpu as pltpu
-
-        ctx = pltpu.force_tpu_interpret_mode()
+    # native on TPU; interpret mode for the off-chip smoke path
+    ctx = interpret_ctx_factory()()
 
     A._flash_block_sizes = patched
     try:
@@ -115,14 +116,7 @@ def _grid():
 
 def main():
     if os.environ.get("GALVATRON_SWEEP_CONFIG"):
-        # honor an explicit non-axon JAX_PLATFORMS (CPU smoke): the axon
-        # plugin pins jax_platforms at registration and only config.update
-        # outranks it (same recipe as bench.py sections)
-        jp = os.environ.get("JAX_PLATFORMS")
-        if jp and "axon" not in jp:
-            import jax
-
-            jax.config.update("jax_platforms", jp)
+        apply_jax_platforms_override()
         name = os.environ["GALVATRON_SWEEP_CONFIG"]
         overrides = dict(_grid())[name]
         ms = bwd_time(overrides)
@@ -152,45 +146,17 @@ def main():
         if name in results:
             continue
         env = dict(os.environ, GALVATRON_SWEEP_CONFIG=name)
-        # children import galvatron_tpu; keep /root/.axon_site on the path or
-        # the axon backend fails to register (verify SKILL.md gotcha)
-        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        extra = [repo, "/root/.axon_site", env.get("PYTHONPATH", "")]
-        env["PYTHONPATH"] = ":".join(p for p in extra if p)
-        # own process group: a wedged child's tunnel helpers must die with it,
-        # or they squat the chip and wedge every later config (bench.py recipe)
-        p = subprocess.Popen(
-            [sys.executable, os.path.abspath(__file__)], env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-            start_new_session=True,
+        env["PYTHONPATH"] = child_pythonpath(env, _REPO)
+        # shared wedge-tolerant harness: own process group (killed as a
+        # unit on timeout), JSON kept even if the child died in teardown
+        payload, rc, err_tail = run_isolated(
+            [sys.executable, os.path.abspath(__file__)], env, CONFIG_TIMEOUT_S,
         )
-        try:
-            out, err = p.communicate(timeout=CONFIG_TIMEOUT_S)
-        except subprocess.TimeoutExpired:
-            try:
-                os.killpg(p.pid, 9)
-            except (OSError, ProcessLookupError):
-                p.kill()
-            try:
-                out, err = p.communicate(timeout=10.0)
-            except subprocess.TimeoutExpired:
-                out = ""
-            print("%s: TIMEOUT (tunnel wedge?)" % name, flush=True)
-            continue
-        # keep whatever was measured: a child that printed its JSON but died
-        # in tunnel teardown still counts (bench.py _extract_json semantics)
-        payload = None
-        for ln in reversed((out or "").strip().splitlines()):
-            ln = ln.strip()
-            if ln.startswith("{"):
-                try:
-                    payload = json.loads(ln)
-                except json.JSONDecodeError:
-                    pass
-                break
         if payload is None:
-            print("%s: FAIL rc=%s %s" % (name, p.returncode,
-                                         (err or "").strip()[-120:]), flush=True)
+            if rc is None:
+                print("%s: TIMEOUT (tunnel wedge?)" % name, flush=True)
+            else:
+                print("%s: FAIL rc=%s %s" % (name, rc, err_tail[-120:]), flush=True)
             continue
         results[name] = payload["ms"]
         print("%s: %.2f ms (device %s)" % (name, results[name],

@@ -1,0 +1,56 @@
+"""bench.py wedge-proofing contract (VERDICT r4 item 1: BENCH_r04 was rc=124
+with NO JSON because one wedged remote compile discarded every measured
+metric). The orchestrator must always print one parseable JSON line and exit
+0 — even when every section times out."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+BENCH = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "bench.py")
+
+EXTENDED = bool(os.environ.get("GALVATRON_EXTENDED_TESTS"))
+
+
+def run_bench(env_extra, timeout):
+    env = dict(os.environ, GALVATRON_BENCH_SMOKE="1", **env_extra)
+    p = subprocess.run([sys.executable, BENCH], env=env, capture_output=True,
+                       text=True, timeout=timeout)
+    lines = [ln for ln in p.stdout.strip().splitlines() if ln.startswith("{")]
+    assert p.returncode == 0, (p.returncode, p.stderr[-500:])
+    assert lines, "no JSON line emitted: %r" % p.stdout[-500:]
+    return json.loads(lines[-1])
+
+
+def test_emits_partial_json_when_every_section_wedges():
+    """A deadline so small every section is skipped still produces the
+    headline JSON (value null, per-section errors recorded) and rc=0 —
+    a partial bench is a result, not a failure."""
+    out = run_bench({"GALVATRON_BENCH_DEADLINE": "1"}, timeout=120)
+    assert out["value"] is None and out["vs_baseline"] is None
+    assert "errors" in out["extra"]
+    assert "layer_fwd" in out["extra"]["errors"]
+    assert out["extra"]["train_step"]["error"]
+
+
+def test_section_child_wedge_is_killed_and_reported():
+    """A child that hangs (simulated via an env hook is overkill — a 25s
+    deadline with real sections compiling is enough to hit the skip path for
+    later sections) never blocks the final emit past deadline+20."""
+    out = run_bench({"GALVATRON_BENCH_DEADLINE": "25"}, timeout=150)
+    # whatever happened, the JSON schema held
+    assert out["metric"].startswith("SMOKE_")
+    assert "extra" in out
+
+
+@pytest.mark.skipif(not EXTENDED, reason="full smoke bench is ~3-6 min on CPU")
+def test_full_smoke_bench_on_cpu():
+    env = {"JAX_PLATFORMS": "cpu", "GALVATRON_BENCH_DEADLINE": "500"}
+    out = run_bench(env, timeout=560)
+    assert out["value"] is not None and out["value"] > 0
+    ts = out["extra"]["train_step"]
+    assert ts["step_ms"] > 0 and ts["tokens_per_sec_per_chip"] > 0
+    assert out["extra"]["masked_flash"]["masked_vs_unmasked"] > 0
