@@ -402,8 +402,10 @@ SECTIONS = {
 # common budgets are 900s, so the normal-path emit must land by ~780s and the
 # last-resort watchdog by ~800s — comfortably inside.
 DEADLINE_S = float(os.environ.get("GALVATRON_BENCH_DEADLINE", "200" if SMOKE else "780"))
+# masked_flash compiles three attention programs through the tunnel
+# (~20-40s each), so it gets headroom; the deadline still caps the total
 SECTION_BUDGETS = {"layer_fwd": 300.0, "train_step": 360.0, "breakdown": 200.0,
-                   "masked_flash": 150.0}
+                   "masked_flash": 180.0}
 _START = time.time()
 _ACTIVE_CHILD = None  # Popen of the in-flight section, for watchdog cleanup
 
