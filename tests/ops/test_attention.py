@@ -108,21 +108,32 @@ def test_ring_attention_padding_bias_matches_dense(devices8):
     )
 
 
+def _ring_mem_setup(devices8):
+    """Shared scaffolding for the ring-attention compiled-memory gates: one
+    mesh/axes/abstract-input recipe so both tests measure the same config."""
+    mesh = Mesh(np.array(devices8).reshape(2, 4), ("m0", "m1"))
+    axes = LayerAxes(dp=("m0",), cp=("m1",), tp=())
+
+    def structs(s, b=2, nh=4, hd=16):
+        q = jax.ShapeDtypeStruct((b, s, nh, hd), jnp.float32,
+                                 sharding=NamedSharding(mesh, P("m0", "m1", None, None)))
+        pos = jax.ShapeDtypeStruct((b, s), jnp.int32,
+                                   sharding=NamedSharding(mesh, P("m0", "m1")))
+        return q, pos
+
+    return mesh, axes, structs
+
+
 def test_ring_attention_blockwise_memory_scales_linearly(devices8):
     """The per-step working set must be O(sq * key_chunk), not O(S^2/cp):
     doubling S must scale the compiled temp bytes ~linearly (the round-2
     full-logits implementation scaled quadratically)."""
     from galvatron_tpu.ops import ring_attention as R
 
-    mesh = Mesh(np.array(devices8).reshape(2, 4), ("m0", "m1"))
-    axes = LayerAxes(dp=("m0",), cp=("m1",), tp=())
+    mesh, axes, structs = _ring_mem_setup(devices8)
 
     def temp_bytes(s):
-        b, nh, hd = 2, 4, 16
-        q = jax.ShapeDtypeStruct((b, s, nh, hd), jnp.float32,
-                                 sharding=NamedSharding(mesh, P("m0", "m1", None, None)))
-        pos = jax.ShapeDtypeStruct((b, s), jnp.int32,
-                                   sharding=NamedSharding(mesh, P("m0", "m1")))
+        q, pos = structs(s)
 
         def f(q, k, v, pos):
             return R.ring_attention(q, k, v, pos, mesh=mesh, axes=axes, causal=True)
@@ -371,3 +382,32 @@ def test_explicit_flash_with_untileable_padded_batch_falls_back():
                            bias_type="key_padding")
     ref = A._xla_attention(q, k, v, causal=False, sm_scale=hd**-0.5, bias=bias)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5)
+
+
+def test_ring_custom_vjp_backward_memory_beats_autodiff(devices8):
+    """The point of the hand-written ring backward: probabilities recompute
+    from the saved lse, so no per-chunk residuals survive the forward.
+    Compiled temp bytes of the gradient program must stay bounded where
+    autodiff's transpose-of-scan residuals grow superlinearly (measured on
+    this mesh: S=4096 custom 28 MB vs autodiff 247 MB)."""
+    from galvatron_tpu.ops import ring_attention as R
+
+    mesh, axes, structs = _ring_mem_setup(devices8)
+
+    def temp_bytes(s, use_custom):
+        q, pos = structs(s)
+
+        def loss(q_, k_, v_, pos_):
+            out = R.ring_attention(q_, k_, v_, pos_, mesh=mesh, axes=axes,
+                                   causal=True, use_custom_vjp=use_custom)
+            return jnp.sum(out.astype(jnp.float32) ** 2)
+
+        g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        return g.lower(q, q, q, pos).compile().memory_analysis().temp_size_in_bytes
+
+    big_custom = temp_bytes(4096, True)
+    big_auto = temp_bytes(4096, False)
+    assert big_custom < 0.4 * big_auto, (big_custom, big_auto)
+    # and the custom backward never costs meaningfully MORE than autodiff
+    small_custom, small_auto = temp_bytes(2048, True), temp_bytes(2048, False)
+    assert small_custom < 1.1 * small_auto, (small_custom, small_auto)
