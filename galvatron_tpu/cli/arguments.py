@@ -99,6 +99,41 @@ def _add_train_args(p: argparse.ArgumentParser):
     g.add_argument("--save_interval", type=int, default=0, help="0 => only at end")
     g.add_argument("--distributed_checkpoint", type=int, default=1)
     g.add_argument("--log_interval", type=int, default=1)
+    # resilience (runtime/resilience.py): preemption-safe checkpointing,
+    # anomaly guard, retry/retention around checkpoint and dataloader I/O
+    r = p.add_argument_group("resilience")
+    r.add_argument("--keep_latest_k", type=int, default=0,
+                   help="GC all but the newest K checkpoints after each save "
+                        "(0 => keep all)")
+    r.add_argument("--emergency_save", type=int, default=1,
+                   help="on SIGTERM/SIGINT, save a checkpoint at the next "
+                        "step boundary (needs --save) and exit cleanly")
+    r.add_argument("--anomaly_guard", type=int, default=1,
+                   help="skip updates whose loss/grad norm is NaN/Inf (or "
+                        "spikes past --loss_spike_factor) instead of "
+                        "training through them")
+    r.add_argument("--loss_spike_factor", type=float, default=0.0,
+                   help="treat loss > factor * EMA(accepted losses) as an "
+                        "anomaly (0 => NaN/Inf detection only)")
+    r.add_argument("--anomaly_min_history", type=int, default=5,
+                   help="accepted losses before the spike cap arms")
+    r.add_argument("--anomaly_max_strikes", type=int, default=3,
+                   help="consecutive anomalies before rolling back to the "
+                        "last checkpoint")
+    r.add_argument("--anomaly_max_rollbacks", type=int, default=3,
+                   help="rollbacks before giving up with an error")
+    r.add_argument("--anomaly_reseed", type=int, default=0,
+                   help="offset added to the data-stream step after each "
+                        "rollback, to step past a deterministically "
+                        "poisoned batch (0 => replay the same stream)")
+    r.add_argument("--ckpt_retries", type=int, default=2,
+                   help="retry budget (exponential backoff) for checkpoint "
+                        "save/restore and dataloader I/O")
+    r.add_argument("--ckpt_retry_backoff", type=float, default=0.5,
+                   help="base backoff delay in seconds")
+    r.add_argument("--verify_checkpoint", type=int, default=1,
+                   help="verify the integrity manifest on resume and fall "
+                        "back to the latest intact checkpoint")
 
 
 def _add_profile_args(p: argparse.ArgumentParser):

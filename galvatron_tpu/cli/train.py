@@ -21,6 +21,7 @@ from galvatron_tpu.cli.arguments import (
 )
 from galvatron_tpu.profiler.runtime import RuntimeProfiler
 from galvatron_tpu.runtime import checkpoint as ckpt
+from galvatron_tpu.runtime import resilience as rsl
 from galvatron_tpu.runtime.dataloader import get_train_iterator
 from galvatron_tpu.runtime.model_api import construct_hybrid_parallel_model
 from galvatron_tpu.runtime.optimizer import OptimizerArgs, get_optimizer_and_scheduler
@@ -99,12 +100,31 @@ def build_data_iterator(args, fam, cfg, hp, start_step: int = 0,
 
 
 def train(args) -> dict:
-    """Returns a summary dict (losses, timing) for tests/driver use."""
+    """Returns a summary dict (losses, timing, resilience counters) for
+    tests/driver use."""
     fam, cfg = model_config_from_args(args)
     world = args.world_size or len(jax.devices())
     hp = hp_config_from_args(args, cfg.num_layers, world)
     if jax.process_index() == 0:
         print(hp.describe())
+
+    # ------------------------------------------------------------- resilience
+    res = rsl.ResilienceCounters()
+    retry_policy = rsl.RetryPolicy(
+        retries=max(getattr(args, "ckpt_retries", 2), 0),
+        base_delay_s=getattr(args, "ckpt_retry_backoff", 0.5),
+    )
+    # fault-injection seam (tests/runtime/fault_injection.py); None in prod
+    hooks = getattr(args, "fault_hooks", None)
+    guard = None
+    if getattr(args, "anomaly_guard", 0):
+        guard = rsl.AnomalyGuard(rsl.AnomalyGuardConfig(
+            spike_factor=getattr(args, "loss_spike_factor", 0.0),
+            min_history=getattr(args, "anomaly_min_history", 5),
+            max_strikes=getattr(args, "anomaly_max_strikes", 3),
+            max_rollbacks=getattr(args, "anomaly_max_rollbacks", 3),
+        ))
+    verify_ckpt = bool(getattr(args, "verify_checkpoint", 1))
 
     # families with their own param tree (t5/swin) supply a build hook
     model = fam.build(cfg, hp) if fam.build else construct_hybrid_parallel_model(cfg, hp)
@@ -112,29 +132,50 @@ def train(args) -> dict:
 
     params = model.init_params(jax.random.PRNGKey(args.seed))
     opt_state = model.init_opt_state(tx, params)
+
+    def load_from(ckpt_dir, iteration):
+        return rsl.with_retry(
+            lambda: ckpt.load_checkpoint(
+                ckpt_dir,
+                iteration,
+                params_target=params,
+                params_shardings=model.shardings(),
+                opt_state_target=opt_state,
+                opt_state_shardings=model.opt_state_shardings(tx, params),
+                hp=hp,
+                verify_integrity=verify_ckpt,
+            ),
+            retry_policy, res, description="checkpoint restore",
+        )
+
     start_iter = 0
     if args.load:
         fresh_opt_state = opt_state
-        params, opt_state, meta = ckpt.load_checkpoint(
-            args.load,
-            args.load_iteration,
-            params_target=params,
-            params_shardings=model.shardings(),
-            opt_state_target=opt_state,
-            opt_state_shardings=model.opt_state_shardings(tx, params),
-            hp=hp,
-        )
+        params, opt_state, meta = load_from(args.load, args.load_iteration)
         if opt_state is None:
             # params-only checkpoint (h2g conversion): optimizer starts fresh
             opt_state = fresh_opt_state
         start_iter = int(meta.get("iteration", 0))
+        res.torn_checkpoints_skipped += len(meta.get("torn_iterations", ()))
         if jax.process_index() == 0:
             print("resumed from %s at iteration %d" % (args.load, start_iter))
 
-    step_fn = model.make_train_step(tx)
+    step_fn = model.make_train_step(tx, guard_anomalies=guard is not None)
+    if hooks is not None and hooks.wrap_step_fn:
+        step_fn = hooks.wrap_step_fn(step_fn)
+
     # deterministic resume: streams are stateless functions of the step index
     # (the reference keeps Megatron dataset cursors in the optimizer checkpoint)
-    data_iter = build_data_iterator(args, fam, cfg, hp, start_step=start_iter)
+    def make_stream(start_step: int):
+        it_ = rsl.with_retry(
+            lambda: build_data_iterator(args, fam, cfg, hp, start_step=start_step),
+            retry_policy, res, description="dataloader build",
+        )
+        if hooks is not None and hooks.wrap_data_iter:
+            it_ = hooks.wrap_data_iter(it_, start_step)
+        return it_
+
+    data_iter = make_stream(start_iter)
 
     eval_interval = getattr(args, "eval_interval", 0) or 0
     eval_iters = max(getattr(args, "eval_iters", 5) or 0, 1)
@@ -170,31 +211,125 @@ def train(args) -> dict:
         log_dir=getattr(args, "train_log_dir", None),
     )
 
+    preempt = None
+    if getattr(args, "emergency_save", 0):
+        preempt = rsl.PreemptionHandler().install()
+
+    def save_now(iteration: int, emergency: bool = False):
+        meta = {"iteration": iteration}
+        if emergency:
+            meta["emergency"] = True
+            meta["signal"] = preempt.signal_name if preempt else None
+        rsl.with_retry(
+            lambda: ckpt.save_checkpoint(
+                args.save, iteration, params, opt_state, hp, train_meta=meta,
+                keep_latest_k=getattr(args, "keep_latest_k", 0) or None,
+            ),
+            retry_policy, res, description="checkpoint save",
+        )
+
     losses = []
+    loss_iters = []  # iteration of each accepted loss (rollback truncation)
     valid_losses = []  # (iteration, mean valid loss)
+    interrupted = None
+    last_save = None
     it = start_iter
-    for it in range(start_iter, args.train_iters):
-        batch = next(data_iter)
-        batch = model.shard_batch(batch)
-        prof.start(it)
-        params, opt_state, metrics = step_fn(params, opt_state, batch)
-        prof.end(it, n_samples=hp.global_bsz, outputs=metrics["loss"])
-        if args.profile or it % max(args.log_interval, 1) == 0:
-            prof.log_iteration(it, metrics)
-        losses.append(float(metrics["loss"]))
-        if eval_interval and (it + 1) % eval_interval == 0:
-            vloss = evaluate(params, "valid")
-            valid_losses.append((it + 1, vloss))
+    try:
+        while it < args.train_iters:
+            if hooks is not None and hooks.on_step:
+                hooks.on_step(it)
+            if preempt is not None and preempt.triggered:
+                interrupted = preempt.signal_name
+                break
+            batch = rsl.with_retry(lambda: next(data_iter), retry_policy, res,
+                                   description="dataloader")
+            batch = model.shard_batch(batch)
+            prof.start(it)
+            if guard is not None:
+                params, opt_state, metrics = step_fn(
+                    params, opt_state, batch, np.float32(guard.spike_cap()))
+            else:
+                params, opt_state, metrics = step_fn(params, opt_state, batch)
+            prof.end(it, n_samples=hp.global_bsz, outputs=metrics["loss"])
+            if args.profile or it % max(args.log_interval, 1) == 0:
+                prof.log_iteration(it, metrics)
+            loss = float(metrics["loss"])
+            verdict = guard.observe(loss) if guard is not None else "ok"
+            if verdict == "ok":
+                losses.append(loss)
+                loss_iters.append(it)
+            else:
+                # the jitted step already kept the old params/opt_state
+                # (guard_anomalies select); only account and maybe roll back
+                res.anomalies_skipped += 1
+                if jax.process_index() == 0:
+                    print(
+                        "iteration %d: %s anomaly (loss %r) — update skipped "
+                        "(strike %d/%d)"
+                        % (it, verdict, loss, guard.strikes, guard.cfg.max_strikes)
+                    )
+                if guard.should_roll_back:
+                    intact = ckpt.intact_iterations(args.save) if args.save else []
+                    if res.rollbacks >= guard.cfg.max_rollbacks or not intact:
+                        raise rsl.TrainingAnomalyError(
+                            "persistent training anomalies at iteration %d "
+                            "(%d consecutive; %d rollbacks used, %s checkpoints "
+                            "to roll back to)"
+                            % (it, guard.strikes, res.rollbacks,
+                               len(intact) if args.save else "no")
+                        )
+                    res.rollbacks += 1
+                    prev_opt_state = opt_state
+                    params, opt_state, meta = load_from(args.save, None)
+                    if opt_state is None:  # params-only checkpoint
+                        opt_state = prev_opt_state
+                    it = int(meta.get("iteration", 0))
+                    res.torn_checkpoints_skipped += len(meta.get("torn_iterations", ()))
+                    while loss_iters and loss_iters[-1] >= it:
+                        loss_iters.pop()
+                        losses.pop()
+                    while valid_losses and valid_losses[-1][0] > it:
+                        valid_losses.pop()
+                    # optional stream reseed: shift the deterministic stream
+                    # so the replay does not hit the same poisoned batch
+                    offset = res.rollbacks * getattr(args, "anomaly_reseed", 0)
+                    data_iter = make_stream(it + offset)
+                    guard.reset_after_rollback()
+                    if jax.process_index() == 0:
+                        print(
+                            "rolled back to checkpoint iteration %d "
+                            "(rollback %d/%d, stream offset +%d)"
+                            % (it, res.rollbacks, guard.cfg.max_rollbacks, offset)
+                        )
+                    continue
+            if eval_interval and (it + 1) % eval_interval == 0:
+                vloss = evaluate(params, "valid")
+                valid_losses.append((it + 1, vloss))
+                if jax.process_index() == 0:
+                    print("iteration %d: valid loss %.6f" % (it + 1, vloss))
+            if args.save and args.save_interval and (it + 1) % args.save_interval == 0:
+                save_now(it + 1)
+                last_save = it + 1
+            it += 1
+        if interrupted is not None and args.save and last_save != it:
+            # preemption: commit the state reached so far at the step boundary
+            save_now(it, emergency=True)
+            res.emergency_saves += 1
+            last_save = it
             if jax.process_index() == 0:
-                print("iteration %d: valid loss %.6f" % (it + 1, vloss))
-        if args.save and args.save_interval and (it + 1) % args.save_interval == 0:
-            ckpt.save_checkpoint(args.save, it + 1, params, opt_state, hp,
-                                 train_meta={"iteration": it + 1})
-    if args.save:
-        ckpt.save_checkpoint(args.save, it + 1, params, opt_state, hp,
-                             train_meta={"iteration": it + 1})
+                print("emergency checkpoint at iteration %d (%s)" % (it, interrupted))
+        elif args.save and last_save != it:
+            save_now(it)
+            last_save = it
+    finally:
+        if preempt is not None:
+            preempt.uninstall()
+    prof.resilience_counters = res.as_dict()
     summary = prof.summary()
     summary["losses"] = losses
+    summary["resilience"] = res.as_dict()
+    if interrupted is not None:
+        summary["interrupted"] = interrupted
     if eval_interval:
         summary["valid_losses"] = valid_losses
         summary["test_loss"] = evaluate(params, "test")

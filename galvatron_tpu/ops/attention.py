@@ -129,6 +129,17 @@ def core_attention(
     additive bias (T5 relative positions) still falls back."""
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    if bias_type == "key_padding" and q.shape[1] != k.shape[1]:
+        # the segment-id lowering reuses the key mask for the query side; on
+        # a cross-attention call with q_len != kv_len that is detectably
+        # wrong — fail loudly instead of producing silently wrong rows (the
+        # equal-length cross-attention case remains the caller's contract)
+        raise ValueError(
+            "bias_type='key_padding' is a SELF-attention contract (query and "
+            "key padding assumed identical); got q_len=%d != kv_len=%d — use "
+            "the default additive bias_type for cross-attention"
+            % (q.shape[1], k.shape[1])
+        )
     if k.shape[2] != q.shape[2]:
         assert q.shape[2] % k.shape[2] == 0, "q heads must be a multiple of kv heads"
         n_rep = q.shape[2] // k.shape[2]
@@ -145,9 +156,9 @@ def core_attention(
         and bias.shape[3] == k.shape[1] and q.shape[1] == k.shape[1]
         and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0
     )
+    # the pallas kernel is TPU-only ("axon" is the tunnelled TPU backend)
+    on_tpu = jax.default_backend() in ("tpu", "axon")
     if impl == "auto":
-        # the pallas kernel is TPU-only ("axon" is the tunnelled TPU backend)
-        on_tpu = jax.default_backend() in ("tpu", "axon")
         # pallas flash path needs seq/head tiling-friendly shapes
         ok_shapes = (
             q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0 and q.shape[3] >= 128
@@ -160,9 +171,14 @@ def core_attention(
         # (b, nh, s, s) fp32 logits.
         impl = "flash" if (on_tpu and ok_shapes) else "xla"
     if impl == "flash":
-        if bias is not None and not seg_flash_ok:
+        if bias is not None and (not seg_flash_ok or not on_tpu):
             # the pallas flash kernel takes no generic additive bias; fall
-            # back rather than silently dropping it
+            # back rather than silently dropping it. Off-TPU the segment-id
+            # kernel dispatch is also gated off: explicit impl="flash"
+            # families (gpt_fa/llama_fa) with a padded batch must keep the
+            # XLA fallback on CPU instead of crashing in the pallas kernel
+            # (ADVICE r5; unbiased explicit flash stays TPU-only as
+            # documented).
             return _xla_attention(q, k, v, causal=causal, sm_scale=sm_scale, bias=bias)
         seg = padding_bias_to_segment_ids(bias) if bias is not None else None
         return _pallas_flash(q, k, v, causal=causal, sm_scale=sm_scale,

@@ -78,6 +78,9 @@ class RuntimeProfiler:
     all_times_ms: List[float] = field(default_factory=list)
     samples: List[int] = field(default_factory=list)
     memory_snapshots: Dict[str, Dict[str, float]] = field(default_factory=dict)
+    resilience_counters: Optional[Dict[str, int]] = None  # set by the train
+    # driver (runtime/resilience.py ResilienceCounters.as_dict()): anomalies
+    # skipped, rollbacks, I/O retries, emergency saves, torn checkpoints
     _iter: int = 0
 
     # ------------------------------------------------------------------ timing
@@ -108,21 +111,25 @@ class RuntimeProfiler:
     # ----------------------------------------------------------------- summary
     def summary(self) -> Dict[str, float]:
         if not self.iter_times_ms:
-            return {"avg_iter_ms": 0.0, "samples_per_s": 0.0, "iters": 0}
-        avg = float(np.mean(self.iter_times_ms))
-        tput = (
-            float(np.sum(self.samples)) / (float(np.sum(self.iter_times_ms)) / 1e3)
-            if np.sum(self.iter_times_ms) > 0
-            else 0.0
-        )
-        peak = max((m["peak_bytes_in_use"] for m in self.memory_snapshots.values()), default=0.0)
-        return {
-            "avg_iter_ms": avg,
-            "p50_iter_ms": float(np.percentile(self.iter_times_ms, 50)),
-            "samples_per_s": tput,
-            "peak_hbm_mb": peak / 2**20,
-            "iters": len(self.iter_times_ms),
-        }
+            out = {"avg_iter_ms": 0.0, "samples_per_s": 0.0, "iters": 0}
+        else:
+            avg = float(np.mean(self.iter_times_ms))
+            tput = (
+                float(np.sum(self.samples)) / (float(np.sum(self.iter_times_ms)) / 1e3)
+                if np.sum(self.iter_times_ms) > 0
+                else 0.0
+            )
+            peak = max((m["peak_bytes_in_use"] for m in self.memory_snapshots.values()), default=0.0)
+            out = {
+                "avg_iter_ms": avg,
+                "p50_iter_ms": float(np.percentile(self.iter_times_ms, 50)),
+                "samples_per_s": tput,
+                "peak_hbm_mb": peak / 2**20,
+                "iters": len(self.iter_times_ms),
+            }
+        if self.resilience_counters is not None:
+            out["resilience"] = dict(self.resilience_counters)
+        return out
 
     def log_iteration(self, iteration: int, metrics: Optional[dict] = None, print_fn=print):
         """reference _log_iteration_stats (runtime_profiler.py:303)."""

@@ -1,8 +1,26 @@
 from galvatron_tpu.runtime.model_api import HybridParallelModel, construct_hybrid_parallel_model
 from galvatron_tpu.runtime.optimizer import get_optimizer_and_scheduler
+from galvatron_tpu.runtime.resilience import (
+    AnomalyGuard,
+    AnomalyGuardConfig,
+    FaultHooks,
+    PreemptionHandler,
+    ResilienceCounters,
+    RetryPolicy,
+    TrainingAnomalyError,
+    with_retry,
+)
 
 __all__ = [
     "HybridParallelModel",
     "construct_hybrid_parallel_model",
     "get_optimizer_and_scheduler",
+    "AnomalyGuard",
+    "AnomalyGuardConfig",
+    "FaultHooks",
+    "PreemptionHandler",
+    "ResilienceCounters",
+    "RetryPolicy",
+    "TrainingAnomalyError",
+    "with_retry",
 ]

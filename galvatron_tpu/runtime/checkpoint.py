@@ -1,4 +1,4 @@
-"""Distributed checkpoint save / resume.
+"""Distributed checkpoint save / resume with torn-write detection.
 
 TPU-native counterpart of the reference's distributed checkpoint system
 (models/llama_hf/LlamaModel_checkpoint.py:148-220: per-FSDP-module
@@ -18,12 +18,43 @@ Layout under ``<dir>/``:
     hybrid_parallel_config.json      strategy fingerprint (assert-equal on resume)
     meta.json                        model family/size, world size
     <iteration>/                     orbax composite: params, opt_state, train_meta
+    manifests/<iteration>.json       post-save integrity manifest (below)
+
+Integrity manifest
+------------------
+A preempted or killed process can leave a torn ``<iteration>/`` directory
+that poisons the next resume. The manifest is the commit record: it is
+written atomically (tmp file + ``os.replace``) *after* the orbax save
+completes, so a step directory without a matching manifest is by definition
+torn. Each manifest records, per item (``params`` / ``opt_state`` /
+``train_meta``):
+
+    ``digest``       sha256 over every leaf's (path, dtype, shape, bytes),
+                     in deterministic flatten order (None when some shards
+                     are not host-addressable, i.e. multi-host meshes)
+    ``spec_digest``  sha256 over (path, dtype, shape) only
+    ``num_leaves``   leaf count
+
+plus the step metadata (iteration, save unix time). ``load_checkpoint``
+verifies the manifest: a missing manifest or a value-digest mismatch marks
+the step torn, and — when no explicit iteration was requested — restore
+falls back to the latest *intact* step instead of crashing. A
+``spec_digest`` mismatch (caller restores under different dtypes/shapes,
+e.g. a precision change) skips value verification with a warning rather
+than failing. Checkpoint directories written before this discipline (no
+``manifests/`` dir) are accepted as-is for back-compat.
+
+Retention: `keep_latest_k` on save (the driver's ``--keep_latest_k``)
+garbage-collects the oldest step dirs and their manifests.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
-from typing import Any, Dict, Optional
+import time
+from typing import Any, Dict, List, Optional
 
 import jax
 import numpy as np
@@ -32,12 +63,89 @@ import orbax.checkpoint as ocp
 from galvatron_tpu.config.strategy import HybridParallelConfig
 from galvatron_tpu.utils.jsonio import read_json_config, write_json_config
 
+MANIFEST_DIRNAME = "manifests"
+
+# test-only seam (tests/runtime/fault_injection.py): called after the orbax
+# write completes but before the manifest commit — the torn-save window a
+# preemption kill actually hits
+_before_manifest_write = None
+
 
 def _manager(ckpt_dir: str, create: bool = False) -> ocp.CheckpointManager:
     options = ocp.CheckpointManagerOptions(create=create, enable_async_checkpointing=False)
     return ocp.CheckpointManager(os.path.abspath(ckpt_dir), options=options)
 
 
+# ----------------------------------------------------------------- manifests
+def _manifest_path(ckpt_dir: str, iteration: int) -> str:
+    return os.path.join(ckpt_dir, MANIFEST_DIRNAME, "%d.json" % iteration)
+
+
+def _tree_digests(tree: Any) -> Dict[str, Any]:
+    """Per-item integrity record: value digest (None when shards are not
+    addressable), structure-only digest, leaf count."""
+    value = hashlib.sha256()
+    spec = hashlib.sha256()
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    addressable = True
+    for path, leaf in leaves:
+        key = jax.tree_util.keystr(path).encode()
+        try:
+            arr = np.asarray(jax.device_get(leaf))
+        except Exception:
+            addressable = False
+            arr = None
+        if arr is not None:
+            spec.update(key + str(arr.dtype).encode() + str(arr.shape).encode())
+            value.update(key + str(arr.dtype).encode() + str(arr.shape).encode())
+            value.update(arr.tobytes())
+        else:
+            spec.update(key)
+            addressable = False
+    return {
+        "digest": value.hexdigest() if addressable else None,
+        "spec_digest": spec.hexdigest(),
+        "num_leaves": len(leaves),
+    }
+
+
+def _meta_digest(meta: Dict[str, Any]) -> Dict[str, Any]:
+    blob = json.dumps(meta, sort_keys=True).encode()
+    d = hashlib.sha256(blob).hexdigest()
+    return {"digest": d, "spec_digest": d, "num_leaves": 1}
+
+
+def _write_manifest(ckpt_dir: str, iteration: int, items: Dict[str, Dict[str, Any]]) -> None:
+    path = _manifest_path(ckpt_dir, iteration)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    payload = {"format": 1, "iteration": iteration, "saved_at": time.time(), "items": items}
+    tmp = path + ".tmp.%d" % os.getpid()
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=1, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)  # atomic commit: manifest exists => save completed
+
+
+def read_manifest(ckpt_dir: str, iteration: int) -> Optional[Dict[str, Any]]:
+    path = _manifest_path(ckpt_dir, iteration)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None  # a torn manifest marks the step torn too
+
+
+def _has_manifest_discipline(ckpt_dir: str) -> bool:
+    """False for checkpoint dirs written before the manifest era — those are
+    accepted as-is (back-compat); once any manifest exists, a manifest-less
+    step means a torn save."""
+    return os.path.isdir(os.path.join(ckpt_dir, MANIFEST_DIRNAME))
+
+
+# ---------------------------------------------------------------------- save
 def save_checkpoint(
     ckpt_dir: str,
     iteration: int,
@@ -45,26 +153,78 @@ def save_checkpoint(
     opt_state: Any = None,
     hp: Optional[HybridParallelConfig] = None,
     train_meta: Optional[Dict[str, Any]] = None,
+    keep_latest_k: Optional[int] = None,
 ) -> None:
-    """Write params (+ optimizer state + scalar train metadata) at `iteration`."""
+    """Write params (+ optimizer state + scalar train metadata) at `iteration`,
+    commit the integrity manifest, then GC to the newest `keep_latest_k`."""
     os.makedirs(ckpt_dir, exist_ok=True)
     if hp is not None:
         write_json_config(hp.to_json_dict(), os.path.join(ckpt_dir, "hybrid_parallel_config.json"))
     items = {"params": ocp.args.StandardSave(params)}
+    digests = {"params": _tree_digests(params)}
     if opt_state is not None:
         items["opt_state"] = ocp.args.StandardSave(opt_state)
+        digests["opt_state"] = _tree_digests(opt_state)
     if train_meta:
         items["train_meta"] = ocp.args.JsonSave(train_meta)
+        digests["train_meta"] = _meta_digest(train_meta)
     with _manager(ckpt_dir, create=True) as mgr:
+        if iteration in set(mgr.all_steps()):
+            # re-save of an existing step (e.g. retraining over a torn step
+            # after a rollback): replace it wholesale — its manifest, if any,
+            # is invalidated by the overwrite either way
+            mgr.delete(iteration)
+            try:
+                os.remove(_manifest_path(ckpt_dir, iteration))
+            except OSError:
+                pass
         mgr.save(iteration, args=ocp.args.Composite(**items))
         mgr.wait_until_finished()
+    if _before_manifest_write is not None:
+        _before_manifest_write(iteration)
+    if jax.process_index() == 0:
+        _write_manifest(ckpt_dir, iteration, digests)
+    if keep_latest_k:
+        gc_checkpoints(ckpt_dir, keep_latest_k)
 
 
+def gc_checkpoints(ckpt_dir: str, keep_latest_k: int) -> List[int]:
+    """Delete all but the newest `keep_latest_k` steps (and their manifests).
+    Returns the deleted iterations."""
+    if keep_latest_k <= 0 or jax.process_index() != 0:
+        return []
+    with _manager(ckpt_dir) as mgr:
+        steps = sorted(mgr.all_steps())
+        doomed = steps[:-keep_latest_k] if keep_latest_k < len(steps) else []
+        for step in doomed:
+            mgr.delete(step)
+    for step in doomed:
+        try:
+            os.remove(_manifest_path(ckpt_dir, step))
+        except OSError:
+            pass
+    return doomed
+
+
+# ------------------------------------------------------------------- listing
 def latest_iteration(ckpt_dir: str) -> Optional[int]:
     if not os.path.isdir(ckpt_dir):
         return None
     with _manager(ckpt_dir) as mgr:
         return mgr.latest_step()
+
+
+def intact_iterations(ckpt_dir: str) -> List[int]:
+    """Saved steps whose manifest committed (all steps for pre-manifest
+    dirs), ascending. Steps present on disk but missing from this list are
+    torn."""
+    if not os.path.isdir(ckpt_dir):
+        return []
+    with _manager(ckpt_dir) as mgr:
+        steps = sorted(mgr.all_steps())
+    if not _has_manifest_discipline(ckpt_dir):
+        return steps
+    return [s for s in steps if read_manifest(ckpt_dir, s) is not None]
 
 
 def _abstract_like(tree, shardings):
@@ -73,6 +233,35 @@ def _abstract_like(tree, shardings):
         tree,
         shardings,
     )
+
+
+# ---------------------------------------------------------------------- load
+def _verify_items(manifest: Dict[str, Any], restored: Dict[str, Any]) -> Optional[str]:
+    """None when every restored item matches its manifest record; otherwise a
+    reason string. A spec mismatch (different dtypes/shapes requested by the
+    restore target) downgrades to a warning — the bytes legitimately differ."""
+    for name, rec in manifest.get("items", {}).items():
+        if name not in restored:
+            continue  # caller did not request this item
+        got = (
+            _meta_digest(restored[name])
+            if name == "train_meta"
+            else _tree_digests(restored[name])
+        )
+        if rec.get("num_leaves") != got["num_leaves"]:
+            return "item %r: leaf count %s != manifest %s" % (
+                name, got["num_leaves"], rec.get("num_leaves"))
+        if rec.get("spec_digest") != got["spec_digest"]:
+            print(
+                "checkpoint: item %r restored under a different dtype/shape "
+                "spec; skipping value verification" % name
+            )
+            continue
+        if rec.get("digest") is None or got["digest"] is None:
+            continue  # shards not fully addressable at save or restore time
+        if rec["digest"] != got["digest"]:
+            return "item %r: content digest mismatch" % name
+    return None
 
 
 def load_checkpoint(
@@ -85,47 +274,102 @@ def load_checkpoint(
     opt_state_shardings: Any = None,
     hp: Optional[HybridParallelConfig] = None,
     strict_strategy: bool = True,
+    verify_integrity: bool = True,
 ):
     """Restore (params, opt_state, train_meta) re-sharded to the current mesh.
 
     `*_target` are example pytrees (real or ShapeDtypeStruct) giving
     shapes/dtypes; `*_shardings` optional matching NamedShardings. With
     `strict_strategy` the saved strategy must equal `hp` (reference
-    hybrid_parallel_config.py:112-124 resume assert)."""
+    hybrid_parallel_config.py:112-124 resume assert).
+
+    With `verify_integrity` (default), each candidate step must have a
+    committed manifest whose digests match the restored bytes. When
+    `iteration` is None the newest step is tried first and torn steps are
+    skipped (the skipped steps are reported under
+    ``meta["torn_iterations"]``); an explicitly requested `iteration` that
+    fails verification raises instead — the caller asked for that exact
+    state."""
     if hp is not None:
         cfg_path = os.path.join(ckpt_dir, "hybrid_parallel_config.json")
         if os.path.exists(cfg_path):
             saved = HybridParallelConfig.from_json(cfg_path, world_size=hp.world_size)
             if strict_strategy:
                 hp.assert_equal(saved)
+
+    def abstract(tree, sh):
+        if sh is None:
+            return jax.tree.map(lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), tree)
+        return _abstract_like(tree, sh)
+
     with _manager(ckpt_dir) as mgr:
-        if iteration is None:
-            iteration = mgr.latest_step()
-            if iteration is None:
+        explicit = iteration is not None
+        if explicit:
+            candidates = [iteration]
+        else:
+            candidates = sorted(mgr.all_steps(), reverse=True)
+            if not candidates:
                 raise FileNotFoundError("no checkpoint found under %s" % ckpt_dir)
-
-        def abstract(tree, sh):
-            if sh is None:
-                return jax.tree.map(lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), tree)
-            return _abstract_like(tree, sh)
-
-        # only request items actually present: an h2g-converted checkpoint is
-        # params-only (tools/convert_checkpoint.py) — the optimizer then starts
-        # fresh, matching the reference's HF-init path (parallel.py:79-89)
-        try:
-            present = set(dict(mgr.item_metadata(iteration).items()))
-        except Exception:
-            present = {"params", "opt_state", "train_meta"}
-        items = {"params": ocp.args.StandardRestore(abstract(params_target, params_shardings))}
-        if opt_state_target is not None and "opt_state" in present:
-            items["opt_state"] = ocp.args.StandardRestore(
-                abstract(opt_state_target, opt_state_shardings)
+        check = verify_integrity and _has_manifest_discipline(ckpt_dir)
+        torn: Dict[int, str] = {}
+        out = None
+        for step in candidates:
+            manifest = read_manifest(ckpt_dir, step) if check else None
+            if check and manifest is None:
+                reason = "missing/unreadable manifest (torn save)"
+                if explicit:
+                    raise RuntimeError(
+                        "checkpoint %s step %d: %s" % (ckpt_dir, step, reason))
+                torn[step] = reason
+                continue
+            # only request items actually present: an h2g-converted checkpoint
+            # is params-only (tools/convert_checkpoint.py) — the optimizer then
+            # starts fresh, matching the reference's HF-init path
+            # (parallel.py:79-89)
+            try:
+                present = set(dict(mgr.item_metadata(step).items()))
+            except Exception:
+                present = {"params", "opt_state", "train_meta"}
+            items = {"params": ocp.args.StandardRestore(abstract(params_target, params_shardings))}
+            if opt_state_target is not None and "opt_state" in present:
+                items["opt_state"] = ocp.args.StandardRestore(
+                    abstract(opt_state_target, opt_state_shardings)
+                )
+            if "train_meta" in present:
+                items["train_meta"] = ocp.args.JsonRestore()
+            try:
+                out = mgr.restore(step, args=ocp.args.Composite(**items))
+            except Exception as e:
+                if explicit:
+                    raise
+                torn[step] = "restore failed: %s: %s" % (type(e).__name__, e)
+                continue
+            reason = _verify_items(manifest, dict(out.items())) if manifest else None
+            if reason is not None:
+                if explicit:
+                    raise RuntimeError(
+                        "checkpoint %s step %d failed integrity verification: %s"
+                        % (ckpt_dir, step, reason)
+                    )
+                torn[step] = reason
+                out = None
+                continue
+            iteration = step
+            break
+        if out is None:
+            raise FileNotFoundError(
+                "no intact checkpoint under %s (torn steps skipped: %s)"
+                % (ckpt_dir, {k: v for k, v in sorted(torn.items())})
             )
-        if "train_meta" in present:
-            items["train_meta"] = ocp.args.JsonRestore()
-        out = mgr.restore(iteration, args=ocp.args.Composite(**items))
+    if torn:
+        print(
+            "checkpoint: fell back to intact step %d; skipped torn steps %s"
+            % (iteration, sorted(torn))
+        )
     params = out["params"]
     opt_state = out.get("opt_state")
     meta = out.get("train_meta") or {}
     meta.setdefault("iteration", iteration)
+    if torn:
+        meta["torn_iterations"] = sorted(torn)
     return params, opt_state, meta

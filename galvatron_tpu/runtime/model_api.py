@@ -145,14 +145,22 @@ class HybridParallelModel:
             is_leaf=_is_spec,
         )
 
-    def make_train_step(self, tx: optax.GradientTransformation):
+    def make_train_step(self, tx: optax.GradientTransformation, *,
+                        guard_anomalies: bool = False):
+        """The jitted (params, opt_state, batch[, spike_cap]) -> (params,
+        opt_state, metrics) step. With `guard_anomalies` the step takes a
+        fourth `spike_cap` scalar and refuses to apply an update whose loss
+        or grad norm is non-finite or whose loss exceeds the cap: params and
+        opt_state pass through unchanged and metrics["anomalous"] is set.
+        The select must live INSIDE the step — inputs are donated, so the
+        host cannot keep the old state around to retry with."""
         hp, mesh = self.hp, self.mesh
         # pp>1: the scan pipeline consumes the whole batch as `chunks`
         # microbatches itself — no outer accumulation loop.
         chunks = 1 if hp.pp > 1 else hp.chunks
         accum_shardings = self.shardings(self.grad_accum_specs())
 
-        def train_step(params, opt_state, batch):
+        def train_step(params, opt_state, batch, spike_cap=None):
             def mb_loss(p, mb):
                 return self.loss_fn(p, mb)
 
@@ -213,11 +221,28 @@ class HybridParallelModel:
                     )
                     grads = g if grads is None else jax.tree.map(jnp.add, grads, g)
                     loss = loss + l * w
-            updates, opt_state = tx.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
+            updates, new_opt_state = tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
             grad_norm = optax.global_norm(grads)
-            return params, opt_state, {"loss": loss, "grad_norm": grad_norm}
+            metrics = {"loss": loss, "grad_norm": grad_norm}
+            if guard_anomalies:
+                bad = jnp.logical_or(
+                    jnp.logical_or(~jnp.isfinite(loss), ~jnp.isfinite(grad_norm)),
+                    loss > spike_cap,
+                )
+                keep = lambda new, old: jnp.where(bad, old, new)  # noqa: E731
+                new_params = jax.tree.map(keep, new_params, params)
+                # the skipped step also must not advance the optimizer (adam
+                # moments AND the schedule counter stay put)
+                new_opt_state = jax.tree.map(keep, new_opt_state, opt_state)
+                metrics["anomalous"] = bad
+            return new_params, new_opt_state, metrics
 
+        if not guard_anomalies:
+            def plain_step(params, opt_state, batch):
+                return train_step(params, opt_state, batch)
+
+            return jax.jit(plain_step, donate_argnums=(0, 1))
         return jax.jit(train_step, donate_argnums=(0, 1))
 
     def opt_state_shardings(self, tx: optax.GradientTransformation, params: Params):

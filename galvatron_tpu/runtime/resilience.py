@@ -1,0 +1,229 @@
+"""Fault tolerance for long-running hybrid-parallel training.
+
+Galvatron's value proposition is multi-day hybrid-parallel runs, and the
+failures those runs actually see are not exotic: TPU preemption (SIGTERM with
+a grace window), a NaN/Inf loss from a poisoned batch or a flaky chip, and
+transient filesystem/tensorstore errors during checkpoint I/O. The reference
+runtime assumes save/resume just works; the Galvatron-2 execution engine
+calls out fault recovery as first-class. This module supplies the pieces the
+driver (cli/train.py) wires together:
+
+- :class:`PreemptionHandler` — converts SIGTERM/SIGINT into a flag the train
+  loop polls at step boundaries, so an emergency ``save_checkpoint`` happens
+  on a *consistent* params/opt_state snapshot and the process exits cleanly
+  (exit code 0) instead of dying mid-collective.
+- :class:`AnomalyGuard` — host-side accounting for the in-step anomaly gate
+  (``make_train_step(guard_anomalies=True)`` keeps old params/opt_state when
+  the loss or grad norm is non-finite or the loss exceeds a spike cap; the
+  step functions donate their inputs, so the keep-old select MUST live inside
+  the jitted step). The guard tracks an EMA of accepted losses to arm the
+  spike cap, counts consecutive strikes, and signals rollback after N.
+- :func:`with_retry` — exponential backoff around checkpoint save/restore and
+  dataloader I/O for transient ``OSError``-family failures.
+- :class:`ResilienceCounters` — anomalies/rollbacks/retries/emergency-saves
+  counters surfaced in the profiler summary dict.
+- :class:`FaultHooks` — the deterministic fault-injection seam used by
+  tests/runtime/fault_injection.py (wrap the data iterator, wrap the step
+  function, observe step boundaries). Production runs leave it unset.
+
+Checkpoint integrity (the atomic manifest that detects torn saves) lives in
+runtime/checkpoint.py; this module only decides *when* to save, retry, and
+roll back.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import signal
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterator, Optional, Tuple
+
+import numpy as np
+
+
+class TrainingAnomalyError(RuntimeError):
+    """Raised when anomalies persist beyond what rollback can repair
+    (no checkpoint to roll back to, or the rollback budget is exhausted)."""
+
+
+# ------------------------------------------------------------------ counters
+@dataclass
+class ResilienceCounters:
+    """Resilience event counts, merged into the profiler summary dict."""
+
+    anomalies_skipped: int = 0
+    rollbacks: int = 0
+    retries: int = 0
+    emergency_saves: int = 0
+    torn_checkpoints_skipped: int = 0
+
+    def as_dict(self) -> Dict[str, int]:
+        return dataclasses.asdict(self)
+
+
+# --------------------------------------------------------------------- retry
+@dataclass
+class RetryPolicy:
+    """Exponential backoff for transient I/O failures (filesystem flakes,
+    tensorstore timeouts). `retries` is the number of RE-attempts after the
+    first failure; delays are base * multiplier**attempt, capped."""
+
+    retries: int = 2
+    base_delay_s: float = 0.5
+    multiplier: float = 2.0
+    max_delay_s: float = 8.0
+    retryable: Tuple[type, ...] = (OSError,)
+
+
+def with_retry(
+    fn: Callable,
+    policy: Optional[RetryPolicy] = None,
+    counters: Optional[ResilienceCounters] = None,
+    description: str = "operation",
+    sleep: Callable[[float], None] = time.sleep,
+):
+    """Run `fn()`; on a retryable exception, back off exponentially and retry
+    up to `policy.retries` times. Non-retryable exceptions propagate
+    immediately; the last retryable one propagates after the budget."""
+    policy = policy or RetryPolicy()
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except policy.retryable as e:
+            if attempt >= policy.retries:
+                raise
+            delay = min(policy.base_delay_s * policy.multiplier**attempt, policy.max_delay_s)
+            if counters is not None:
+                counters.retries += 1
+            print(
+                "resilience: %s failed (%s: %s); retry %d/%d in %.2fs"
+                % (description, type(e).__name__, e, attempt + 1, policy.retries, delay)
+            )
+            sleep(delay)
+            attempt += 1
+
+
+# ---------------------------------------------------------------- preemption
+class PreemptionHandler:
+    """SIGTERM/SIGINT -> a flag polled at step boundaries.
+
+    TPU preemption delivers SIGTERM with a grace window; a first Ctrl-C asks
+    for a graceful stop the same way. The handler only records the signal —
+    the train loop finishes the in-flight step, writes an emergency
+    checkpoint, and returns normally (clean exit code). A second SIGINT
+    raises KeyboardInterrupt so a stuck save can still be aborted."""
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
+        self.signals = tuple(signals)
+        self._signum: Optional[int] = None
+        self._prev: Dict[int, object] = {}
+        self._installed = False
+
+    def install(self) -> "PreemptionHandler":
+        if threading.current_thread() is not threading.main_thread():
+            return self  # signal handlers only work on the main thread
+        for s in self.signals:
+            self._prev[s] = signal.signal(s, self._handle)
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        for s, prev in self._prev.items():
+            signal.signal(s, prev)
+        self._prev.clear()
+        self._installed = False
+
+    def _handle(self, signum, frame):
+        if self._signum is not None and signum == signal.SIGINT:
+            raise KeyboardInterrupt
+        self._signum = signum
+
+    @property
+    def triggered(self) -> bool:
+        return self._signum is not None
+
+    @property
+    def signal_name(self) -> Optional[str]:
+        return signal.Signals(self._signum).name if self._signum is not None else None
+
+
+# ------------------------------------------------------------- anomaly guard
+@dataclass
+class AnomalyGuardConfig:
+    spike_factor: float = 0.0  # anomaly when loss > spike_factor * EMA; 0 = off
+    ema_beta: float = 0.9
+    min_history: int = 5  # accepted losses before the spike cap arms
+    max_strikes: int = 3  # consecutive anomalies before rollback
+    max_rollbacks: int = 3  # rollbacks before giving up (TrainingAnomalyError)
+
+
+class AnomalyGuard:
+    """Host-side half of the anomaly gate.
+
+    The jitted step already refused to apply a non-finite / spiking update
+    (make_train_step(guard_anomalies=True)); this object reads the step's
+    loss, maintains the accepted-loss EMA that feeds the next step's spike
+    cap, and counts consecutive strikes to decide when skipping is no longer
+    enough and the loop must roll back to the last checkpoint."""
+
+    def __init__(self, cfg: Optional[AnomalyGuardConfig] = None):
+        self.cfg = cfg or AnomalyGuardConfig()
+        self.ema: Optional[float] = None
+        self.accepted = 0
+        self.strikes = 0
+
+    def spike_cap(self) -> float:
+        """The loss ceiling the NEXT step's update must stay under; +inf
+        until spike detection is configured and armed."""
+        if self.cfg.spike_factor and self.accepted >= self.cfg.min_history and self.ema:
+            return float(self.cfg.spike_factor * abs(self.ema))
+        return float("inf")
+
+    def observe(self, loss: float) -> str:
+        """Classify one step's loss: "ok" | "nan" | "spike"."""
+        if not np.isfinite(loss):
+            self.strikes += 1
+            return "nan"
+        if loss > self.spike_cap():
+            self.strikes += 1
+            return "spike"
+        self.strikes = 0
+        self.accepted += 1
+        self.ema = (
+            loss
+            if self.ema is None
+            else self.cfg.ema_beta * self.ema + (1.0 - self.cfg.ema_beta) * loss
+        )
+        return "ok"
+
+    @property
+    def should_roll_back(self) -> bool:
+        return self.strikes >= max(self.cfg.max_strikes, 1)
+
+    def reset_after_rollback(self) -> None:
+        """Restart accounting from the restored state: the EMA belongs to the
+        discarded trajectory, and stale history must not arm a stale cap."""
+        self.ema = None
+        self.accepted = 0
+        self.strikes = 0
+
+
+# ----------------------------------------------------------- fault injection
+@dataclass
+class FaultHooks:
+    """Deterministic fault-injection seam (tests/runtime/fault_injection.py).
+
+    The driver consults `args.fault_hooks` (absent in production): the data
+    iterator and step function are wrapped once per (re)build — including
+    after a rollback — and `on_step(it)` fires at each step boundary before
+    the batch is fetched (where the harness sends itself SIGTERM or arms a
+    mid-save kill)."""
+
+    wrap_data_iter: Optional[Callable[[Iterator, int], Iterator]] = None  # (iter, start_step)
+    wrap_step_fn: Optional[Callable[[Callable], Callable]] = None
+    on_step: Optional[Callable[[int], None]] = None

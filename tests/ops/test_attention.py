@@ -348,8 +348,16 @@ def test_core_attention_padding_dispatch_stays_flash_eligible():
         calls.append(kw.get("segment_ids") is not None)
         import jax.experimental.pallas.tpu as pltpu
 
-        with pltpu.force_tpu_interpret_mode():
-            return orig(q_, k_, v_, **kw)
+        if hasattr(pltpu, "force_tpu_interpret_mode"):
+            with pltpu.force_tpu_interpret_mode():
+                return orig(q_, k_, v_, **kw)
+        # jax <= 0.4.37 has no TPU interpret mode: emulate the kernel's
+        # segment-id semantics on the XLA path (only VALID rows are asserted
+        # below, where the two schemes agree by construction)
+        seg = kw.get("segment_ids")
+        emu_bias = jnp.where(seg.kv[:, None, None, :] > 0, 0.0, -1e9)
+        return A._xla_attention(q_, k_, v_, causal=kw.get("causal", False),
+                                sm_scale=kw["sm_scale"], bias=emu_bias)
 
     import unittest.mock as mock
 
@@ -411,3 +419,38 @@ def test_ring_custom_vjp_backward_memory_beats_autodiff(devices8):
     # and the custom backward never costs meaningfully MORE than autodiff
     small_custom, small_auto = temp_bytes(2048, True), temp_bytes(2048, False)
     assert small_custom < 1.1 * small_auto, (small_custom, small_auto)
+
+
+def test_explicit_flash_key_padding_on_cpu_falls_back():
+    """ADVICE r5: impl="flash" with a key-padding bias at kernel-tileable
+    shapes must still fall back to XLA off-TPU (jax.default_backend() is
+    "cpu" here) instead of dispatching the pallas segment-id kernel."""
+    from galvatron_tpu.ops import attention as A
+
+    b, s, nh, hd = 2, 256, 2, 128
+    q, k, v = _rand_qkv(jax.random.PRNGKey(40), b=b, s=s, nh=nh, hd=hd)
+    mask = np.ones((b, s), np.float32)
+    mask[:, -64:] = 0.0
+    bias = jnp.asarray((1.0 - mask)[:, None, None, :] * -1e9)
+    assert jax.default_backend() == "cpu"
+    out = A.core_attention(q, k, v, causal=False, bias=bias, impl="flash",
+                           bias_type="key_padding")
+    ref = A._xla_attention(q, k, v, causal=False, sm_scale=hd**-0.5, bias=bias)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5)
+
+
+def test_key_padding_cross_attention_lengths_fail_loudly():
+    """ADVICE r5: bias_type="key_padding" is a self-attention contract (the
+    segment-id lowering reuses the key mask for queries); a cross-attention
+    call with q_len != kv_len must raise instead of returning silently wrong
+    valid-row outputs."""
+    import pytest
+
+    from galvatron_tpu.ops import attention as A
+
+    q, _, _ = _rand_qkv(jax.random.PRNGKey(41), s=64)
+    _, k, v = _rand_qkv(jax.random.PRNGKey(42), s=32)
+    bias = jnp.zeros((2, 1, 1, 32), jnp.float32)
+    with pytest.raises(ValueError, match="SELF-attention"):
+        A.core_attention(q, k, v, causal=False, bias=bias,
+                         bias_type="key_padding")

@@ -1,0 +1,220 @@
+"""Deterministic fault-injection harness for the resilience layer.
+
+Not a test module (pytest does not collect it): it supplies the injectors
+the resilience tests compose, plus a ``__main__`` entry that runs a tiny
+single-device training job under an injected fault so subprocess tests can
+observe real process-level outcomes (SIGKILL mid-save leaving a torn
+checkpoint, SIGTERM producing an emergency save and a clean exit code).
+
+Injectors plug into the driver through ``args.fault_hooks``
+(runtime/resilience.py FaultHooks) and the checkpoint module's
+``_before_manifest_write`` seam — the window between the orbax write and the
+manifest commit, which is exactly where a preemption kill produces a torn
+checkpoint.
+
+Scenarios (``python -m tests.runtime.fault_injection --scenario ...``):
+    train          plain run (reference trajectory; prints LOSSES=...)
+    resume         run with --load (prints START_ITER=... too)
+    kill_mid_save  SIGKILL between orbax write and manifest commit at
+                   --kill_at; the process dies with -SIGKILL
+    sigterm        the process sends itself SIGTERM at step --sigterm_at;
+                   the loop must emergency-save and exit 0
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+from typing import Dict, Iterator, Sequence
+
+import numpy as np
+
+
+# ------------------------------------------------------------- data injectors
+def _poison_floats(batch: Dict, fill) -> Dict:
+    """Replace every float-dtype entry of the batch with `fill` (NaN batches
+    only make sense for float inputs — pixels, loss masks; token ids stay)."""
+    out = {}
+    for k, v in batch.items():
+        arr = np.asarray(v)
+        if np.issubdtype(arr.dtype, np.floating):
+            out[k] = np.full_like(arr, fill)
+        else:
+            out[k] = v
+    return out
+
+
+def nan_batch_hooks(steps: Sequence[int]):
+    """FaultHooks whose data iterator yields an all-NaN (float fields) batch
+    at the given ABSOLUTE stream steps. Keyed on absolute steps so a
+    post-rollback stream rebuilt with a reseed offset escapes the poison."""
+    from galvatron_tpu.runtime.resilience import FaultHooks
+
+    poisoned = set(steps)
+
+    def wrap(data_iter: Iterator, start_step: int) -> Iterator:
+        step = start_step
+        for batch in data_iter:
+            yield _poison_floats(batch, np.nan) if step in poisoned else batch
+            step += 1
+
+    return FaultHooks(wrap_data_iter=wrap)
+
+
+def spike_batch_hooks(steps: Sequence[int], scale: float = 1e4):
+    """FaultHooks scaling float fields by `scale` at the given absolute
+    steps — a finite loss spike, exercising the --loss_spike_factor path."""
+    from galvatron_tpu.runtime.resilience import FaultHooks
+
+    poisoned = set(steps)
+
+    def wrap(data_iter: Iterator, start_step: int) -> Iterator:
+        step = start_step
+        for batch in data_iter:
+            if step in poisoned:
+                batch = {
+                    k: np.asarray(v) * scale
+                    if np.issubdtype(np.asarray(v).dtype, np.floating) else v
+                    for k, v in batch.items()
+                }
+            yield batch
+            step += 1
+
+    return FaultHooks(wrap_data_iter=wrap)
+
+
+def sigterm_hooks(at_step: int):
+    """FaultHooks sending THIS process SIGTERM at a step boundary — the
+    deterministic stand-in for a TPU preemption notice."""
+    from galvatron_tpu.runtime.resilience import FaultHooks
+
+    def on_step(it: int):
+        if it == at_step:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    return FaultHooks(on_step=on_step)
+
+
+# ------------------------------------------------------------ I/O fault seams
+class flaky_calls:
+    """Context manager: make `module.attr` raise `exc` for the first
+    `failures` calls, then behave normally (transient-filesystem simulation
+    for the retry/backoff path)."""
+
+    def __init__(self, module, attr: str, failures: int, exc=OSError):
+        self.module, self.attr, self.failures, self.exc = module, attr, failures, exc
+        self.calls = 0
+
+    def __enter__(self):
+        self._orig = getattr(self.module, self.attr)
+
+        def wrapper(*a, **kw):
+            self.calls += 1
+            if self.calls <= self.failures:
+                raise self.exc("injected transient failure %d" % self.calls)
+            return self._orig(*a, **kw)
+
+        setattr(self.module, self.attr, wrapper)
+        return self
+
+    def __exit__(self, *exc_info):
+        setattr(self.module, self.attr, self._orig)
+        return False
+
+
+def arm_kill_before_manifest(at_iteration: int):
+    """SIGKILL this process in the torn-save window (after the orbax write,
+    before the manifest commit) when saving `at_iteration`."""
+    from galvatron_tpu.runtime import checkpoint as ckpt
+
+    def bomb(iteration: int):
+        if iteration == at_iteration:
+            sys.stdout.flush()
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    ckpt._before_manifest_write = bomb
+
+
+def tear_checkpoint(ckpt_dir: str, iteration: int, mode: str = "manifest"):
+    """Post-hoc torn-checkpoint simulation: delete the manifest ("manifest")
+    or corrupt the step's array data ("data", flips bytes in one of the
+    largest payload files so the content digest must catch it)."""
+    from galvatron_tpu.runtime.checkpoint import _manifest_path
+
+    if mode == "manifest":
+        os.remove(_manifest_path(ckpt_dir, iteration))
+        return
+    step_dir = os.path.join(ckpt_dir, str(iteration))
+    candidates = []
+    for root, _dirs, files in os.walk(step_dir):
+        for f in files:
+            p = os.path.join(root, f)
+            candidates.append((os.path.getsize(p), p))
+    # corrupt every data-bearing file so SOME requested item is guaranteed hit
+    for size, path in candidates:
+        if size < 64 or os.path.basename(path).startswith(("manifest", ".")):
+            continue
+        with open(path, "r+b") as f:
+            f.seek(size // 2)
+            chunk = f.read(16)
+            f.seek(size // 2)
+            f.write(bytes(b ^ 0xFF for b in chunk))
+
+
+# --------------------------------------------------------- subprocess driver
+def tiny_argv(train_iters: int, save=None, load=None, save_interval=0,
+              extra: Sequence[str] = ()):
+    argv = [
+        "--model_type", "llama", "--set_model_config_manually", "1",
+        "--hidden_size", "32", "--num_attention_heads", "2", "--num_layers", "1",
+        "--vocab_size", "64", "--seq_length", "16", "--mixed_precision", "fp32",
+        "--global_train_batch_size", "2", "--train_iters", str(train_iters),
+        "--lr", "1e-2", "--world_size", "1",
+    ]
+    if save:
+        argv += ["--save", save]
+    if load:
+        argv += ["--load", load]
+    if save_interval:
+        argv += ["--save_interval", str(save_interval)]
+    return argv + list(extra)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser("fault_injection")
+    p.add_argument("--scenario", required=True,
+                   choices=("train", "resume", "kill_mid_save", "sigterm"))
+    p.add_argument("--save", default=None)
+    p.add_argument("--load", default=None)
+    p.add_argument("--iters", type=int, default=6)
+    p.add_argument("--save_interval", type=int, default=0)
+    p.add_argument("--kill_at", type=int, default=4)
+    p.add_argument("--sigterm_at", type=int, default=2)
+    a = p.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_disable_most_optimizations", True)
+
+    from galvatron_tpu.cli.arguments import initialize_galvatron
+    from galvatron_tpu.cli.train import train
+
+    args = initialize_galvatron(mode="train_dist", argv=tiny_argv(
+        a.iters, save=a.save, load=a.load, save_interval=a.save_interval))
+    if a.scenario == "kill_mid_save":
+        arm_kill_before_manifest(a.kill_at)
+    elif a.scenario == "sigterm":
+        args.fault_hooks = sigterm_hooks(a.sigterm_at)
+    summary = train(args)
+    print("LOSSES=" + json.dumps(summary["losses"]))
+    print("RESILIENCE=" + json.dumps(summary["resilience"]))
+    print("INTERRUPTED=" + json.dumps(summary.get("interrupted")))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

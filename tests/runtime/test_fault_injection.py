@@ -1,0 +1,94 @@
+"""Subprocess-based fault-injection simulations: real SIGKILL mid-save (the
+torn-checkpoint window), real SIGTERM preemption with exit-code observation.
+
+These spawn fresh single-device training processes (tests/runtime/
+fault_injection.py __main__), so they carry full jax-import + compile cost
+per scenario — marked `slow` + `fault` and excluded from the tier-1
+`-m 'not slow'` lane; run them with `pytest -m fault`."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+pytestmark = [pytest.mark.slow, pytest.mark.fault]
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_scenario(*argv, expect_rc=0, timeout=600):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # single device is enough for these scenarios; drop the 8-device flag the
+    # outer test process may carry
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "tests.runtime.fault_injection", *argv],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=timeout,
+    )
+    if expect_rc is not None:
+        assert proc.returncode == expect_rc, (proc.returncode, proc.stdout[-3000:],
+                                              proc.stderr[-3000:])
+    return proc
+
+
+def parse(stdout, key):
+    for line in stdout.splitlines():
+        if line.startswith(key + "="):
+            return json.loads(line[len(key) + 1:])
+    raise AssertionError("%s= not found in output" % key)
+
+
+def test_kill_mid_save_leaves_resumable_checkpoint(tmp_path):
+    """SIGKILL between the orbax write and the manifest commit at iteration 4:
+    the process dies hard, iteration 4 is torn, and resume falls back to the
+    latest intact step (2) and reproduces the uninterrupted trajectory."""
+    from galvatron_tpu.runtime import checkpoint as ck
+
+    d = str(tmp_path / "ck")
+    ref = run_scenario("--scenario", "train", "--iters", "6")
+    ref_losses = parse(ref.stdout, "LOSSES")
+
+    proc = run_scenario(
+        "--scenario", "kill_mid_save", "--iters", "6", "--save", d,
+        "--save_interval", "2", "--kill_at", "4", expect_rc=None,
+    )
+    assert proc.returncode == -signal.SIGKILL, (proc.returncode, proc.stderr[-2000:])
+    # step 4 exists on disk but never committed its manifest
+    assert ck.latest_iteration(d) == 4
+    assert ck.intact_iterations(d) == [2]
+
+    resumed = run_scenario("--scenario", "resume", "--iters", "6", "--load", d)
+    res_losses = parse(resumed.stdout, "LOSSES")
+    counters = parse(resumed.stdout, "RESILIENCE")
+    assert counters["torn_checkpoints_skipped"] == 1
+    # fell back to iteration 2 => re-runs steps 2..5, bit-for-bit
+    np.testing.assert_array_equal(res_losses, ref_losses[2:])
+
+
+def test_sigterm_emergency_save_and_clean_exit(tmp_path):
+    """SIGTERM during training: emergency checkpoint at the step boundary,
+    clean exit code 0, and resume continues the exact trajectory."""
+    from galvatron_tpu.runtime import checkpoint as ck
+
+    d = str(tmp_path / "ck")
+    proc = run_scenario(
+        "--scenario", "sigterm", "--iters", "6", "--save", d, "--sigterm_at", "3",
+    )
+    assert parse(proc.stdout, "INTERRUPTED") == "SIGTERM"
+    assert parse(proc.stdout, "RESILIENCE")["emergency_saves"] == 1
+    assert ck.intact_iterations(d) == [3]
+
+    ref = run_scenario("--scenario", "train", "--iters", "6")
+    ref_losses = parse(ref.stdout, "LOSSES")
+    np.testing.assert_array_equal(parse(proc.stdout, "LOSSES"), ref_losses[:3])
+
+    resumed = run_scenario("--scenario", "resume", "--iters", "6", "--load", d)
+    np.testing.assert_array_equal(parse(resumed.stdout, "LOSSES"), ref_losses[3:])
