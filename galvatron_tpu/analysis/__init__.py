@@ -1,0 +1,34 @@
+"""Static analysis for galvatron_tpu: catch bad strategies and broken code
+before any device time is spent.
+
+- `diagnostics`: the shared finding/report framework (codes, severities,
+  JSON output, exit-code contract).
+- `strategy_lint`: validates a searched strategy JSON against a model config
+  and world size with no device or tracing work (GLS*** codes).
+- `code_lint`: AST pass over the package flagging jax-API drift and
+  jit-safety hazards (GLC*** codes).
+
+The package __init__ stays import-light (the config layer imports
+`analysis.diagnostics` from inside `HybridParallelConfig.validate`); the
+linters are loaded lazily on attribute access.
+"""
+
+from galvatron_tpu.analysis.diagnostics import (  # noqa: F401
+    CODES,
+    Diagnostic,
+    DiagnosticError,
+    DiagnosticReport,
+    did_you_mean,
+    make,
+    registry_table,
+)
+
+_LAZY = {"strategy_lint", "code_lint"}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        return importlib.import_module("galvatron_tpu.analysis." + name)
+    raise AttributeError(name)

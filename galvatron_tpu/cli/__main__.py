@@ -7,6 +7,9 @@ Subcommands replace the reference's per-model shell scripts
     search             run the strategy search (CPU only)
     profile            profile model computation/memory
     profile-hardware   profile ICI/DCN collective bandwidths
+    lint               static analysis: validate strategy JSONs / scan code
+                       for jax-API drift and jit hazards (CPU only, no
+                       tracing; exits 1 on error diagnostics)
 """
 
 import sys
@@ -25,6 +28,8 @@ def main():
         from galvatron_tpu.cli.profile import main_model as run
     elif cmd == "profile-hardware":
         from galvatron_tpu.cli.profile import main_hardware as run
+    elif cmd == "lint":
+        from galvatron_tpu.cli.lint import main as run
     else:
         print("unknown subcommand %r\n%s" % (cmd, __doc__))
         return 2

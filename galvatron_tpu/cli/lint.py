@@ -1,0 +1,126 @@
+"""``python -m galvatron_tpu.cli lint`` — static strategy + code analysis.
+
+Usage:
+    # lint searched/hand-written strategy JSONs (no device work):
+    python -m galvatron_tpu.cli lint strategy.json --world_size 8 \
+        --model_type llama --model_size llama-7b --memory_budget_gb 16
+
+    # lint Python sources for jax-API drift and jit-safety hazards:
+    python -m galvatron_tpu.cli lint --code            # the installed package
+    python -m galvatron_tpu.cli lint my_module.py some/dir
+
+Exit-code contract: 0 = clean (warnings allowed), 1 = at least one error
+diagnostic, 2 = usage/IO failure. ``--json`` prints the machine-readable
+report (schema: analysis/diagnostics.py `DiagnosticReport.to_json`);
+``--strict`` upgrades warnings to the failing exit code.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from galvatron_tpu.analysis import diagnostics as D
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("galvatron_tpu-lint", allow_abbrev=False)
+    p.add_argument("paths", nargs="*",
+                   help="strategy .json files and/or .py files / directories")
+    p.add_argument("--code", action="store_true",
+                   help="lint the installed galvatron_tpu package sources "
+                        "(in addition to any explicit paths)")
+    p.add_argument("--json", dest="as_json", action="store_true",
+                   help="machine-readable JSON output")
+    p.add_argument("--strict", action="store_true",
+                   help="exit non-zero on warnings too")
+    p.add_argument("--explain", action="store_true",
+                   help="print the diagnostic-code table and exit")
+    p.add_argument("--world_size", type=int,
+                   default=int(os.environ.get("GALVATRON_WORLD_SIZE", "8")),
+                   help="device count the strategy must tile (default: "
+                        "$GALVATRON_WORLD_SIZE or 8)")
+    p.add_argument("--model_type", type=str, default=None,
+                   help="model family for model-aware checks (heads/seq/vocab "
+                        "divisibility, memory estimate)")
+    p.add_argument("--model_size", type=str, default=None)
+    p.add_argument("--memory_budget_gb", type=float, default=None,
+                   help="HBM budget per chip; enables the GLS101 estimate")
+    p.add_argument("--memory_profile", type=str, default=None,
+                   help="profiled memory JSON (profiler schema) to back the "
+                        "GLS101 estimate instead of the analytic tables")
+    p.add_argument("--rules", type=str, default=None,
+                   help="comma-separated code-lint rule subset, e.g. GLC001")
+    return p
+
+
+def _model_cfg(args):
+    if not args.model_type:
+        return None
+    from galvatron_tpu.models.registry import get_family
+
+    fam = get_family(args.model_type)
+    return fam.config_fn(args.model_size or fam.default_size)
+
+
+def run(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.explain:
+        print(D.registry_table())
+        return 0
+    json_paths = [p for p in args.paths if p.endswith(".json")]
+    code_paths = [p for p in args.paths if not p.endswith(".json")]
+    if args.code:
+        import galvatron_tpu
+
+        code_paths.append(os.path.dirname(galvatron_tpu.__file__))
+    if not json_paths and not code_paths:
+        print("nothing to lint: pass strategy .json / .py paths or --code",
+              file=sys.stderr)
+        return 2
+
+    report = D.DiagnosticReport()
+    if json_paths:
+        from galvatron_tpu.analysis import strategy_lint as S
+        from galvatron_tpu.utils.jsonio import read_json_config
+
+        try:
+            model_cfg = _model_cfg(args)
+        except (KeyError, ValueError) as e:
+            print("bad --model_type/--model_size: %s" % e, file=sys.stderr)
+            return 2
+        memory_profile = None
+        if args.memory_profile:
+            try:
+                memory_profile = read_json_config(args.memory_profile)
+            except (OSError, ValueError) as e:
+                print("cannot read --memory_profile: %s" % e, file=sys.stderr)
+                return 2
+        for path in json_paths:
+            try:
+                report.extend(S.lint_strategy_file(
+                    path, args.world_size, model_cfg=model_cfg,
+                    memory_budget_gb=args.memory_budget_gb,
+                    memory_profile=memory_profile,
+                ).diagnostics)
+            except (OSError, ValueError) as e:
+                print("cannot lint %s: %s" % (path, e), file=sys.stderr)
+                return 2
+    if code_paths:
+        from galvatron_tpu.analysis import code_lint as C
+
+        rules = args.rules.split(",") if args.rules else None
+        report.extend(C.lint_paths(code_paths, rules=rules).diagnostics)
+
+    print(report.to_json() if args.as_json else report.render())
+    if args.strict and report.warnings:
+        return 1
+    return report.exit_code()
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    rc = run(argv)
+    if rc:
+        sys.exit(rc)

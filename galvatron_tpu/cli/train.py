@@ -105,6 +105,20 @@ def train(args) -> dict:
     fam, cfg = model_config_from_args(args)
     world = args.world_size or len(jax.devices())
     hp = hp_config_from_args(args, cfg.num_layers, world)
+    # fail fast on a bad strategy BEFORE any tracing/compilation: the linter
+    # re-checks engine consistency plus the model-aware divisibility rules
+    # (heads/seq/vocab vs tp/cp/sp) that from_json alone cannot see
+    from galvatron_tpu.analysis import strategy_lint as _slint
+    from galvatron_tpu.analysis.diagnostics import DiagnosticError
+
+    _report = _slint.lint_hp(
+        hp, model_cfg=cfg, file=getattr(args, "galvatron_config_path", None),
+    )
+    if jax.process_index() == 0:
+        for _d in _report.warnings:
+            print("strategy lint: %s" % _d.format())
+    if not _report.ok:
+        raise DiagnosticError(_report.errors)
     if jax.process_index() == 0:
         print(hp.describe())
 

@@ -42,6 +42,84 @@ from galvatron_tpu.utils.strategy_utils import array2str, str2array
 
 DP_TYPES = ("ddp", "zero2", "zero3")
 PIPELINE_TYPES = ("gpipe", "pipedream_flush")
+CP_MODES = ("ring", "zigzag")
+
+# The reference-compatible on-disk schema (from_json/to_json_dict). Split by
+# shape so the schema linter can check lengths/types uniformly.
+PER_LAYER_KEYS = (
+    "tp_sizes_enc", "tp_consecutive_flags", "cp_sizes_enc", "dp_types_enc",
+    "use_sp", "checkpoint",
+)
+SCALAR_KEYS = (
+    "pp_deg", "global_bsz", "chunks", "pp_division", "pipeline_type",
+    "default_dp_type", "vtp", "vsp", "vcp", "embed_sdp", "cp_mode",
+)
+KNOWN_STRATEGY_KEYS = frozenset(PER_LAYER_KEYS + SCALAR_KEYS)
+REQUIRED_STRATEGY_KEYS = ("pp_deg", "tp_sizes_enc", "dp_types_enc")
+
+
+def schema_diagnostics(cfg: dict) -> list:
+    """Raw strategy-dict checks shared by `from_json` (which raises on any
+    error) and the strategy linter (which reports them all): unknown keys
+    with did-you-mean hints (GLS001), missing required keys (GLS005),
+    per-layer array length disagreements (GLS006), out-of-range enum values
+    and flags (GLS005). Returns a list of Diagnostics."""
+    from galvatron_tpu.analysis import diagnostics as D
+
+    out = []
+    for k in sorted(cfg):
+        if k not in KNOWN_STRATEGY_KEYS:
+            out.append(D.make(
+                "GLS001", "unknown strategy key %r" % k, key=k,
+                hint=D.did_you_mean(k, KNOWN_STRATEGY_KEYS),
+            ))
+    for k in REQUIRED_STRATEGY_KEYS:
+        if k not in cfg:
+            out.append(D.make("GLS005", "missing required key %r" % k, key=k))
+    arrays = {}
+    for k in PER_LAYER_KEYS:
+        if k in cfg:
+            try:
+                arrays[k] = str2array(cfg[k])
+            except ValueError:
+                out.append(D.make(
+                    "GLS005", "key %r is not a comma-separated int list: %r"
+                    % (k, cfg[k]), key=k,
+                ))
+    if "tp_sizes_enc" in arrays:
+        n = len(arrays["tp_sizes_enc"])
+        for k, arr in arrays.items():
+            if len(arr) != n:
+                out.append(D.make(
+                    "GLS006", "%r has %d entries but 'tp_sizes_enc' has %d"
+                    % (k, len(arr), n), key=k,
+                ))
+    for k, lo in (("tp_sizes_enc", 1), ("cp_sizes_enc", 1)):
+        for i, v in enumerate(arrays.get(k, [])):
+            if v < lo:
+                out.append(D.make(
+                    "GLS005", "%s[%d]=%d must be >= %d" % (k, i, v, lo),
+                    key=k, layer=i,
+                ))
+    for k in ("dp_types_enc", "use_sp", "checkpoint", "tp_consecutive_flags"):
+        for i, v in enumerate(arrays.get(k, [])):
+            if v not in (0, 1):
+                out.append(D.make(
+                    "GLS005", "%s[%d]=%d must be 0 or 1" % (k, i, v),
+                    key=k, layer=i,
+                ))
+    for k, allowed in (
+        ("pipeline_type", PIPELINE_TYPES),
+        ("default_dp_type", DP_TYPES),
+        ("cp_mode", CP_MODES),
+    ):
+        v = cfg.get(k)
+        if v is not None and v not in allowed:
+            out.append(D.make(
+                "GLS005", "%s must be one of %s, got %r" % (k, allowed, v),
+                key=k, hint=D.did_you_mean(str(v), allowed),
+            ))
+    return out
 
 
 @dataclass(frozen=True)
@@ -111,39 +189,73 @@ class HybridParallelConfig:
         self.validate()
 
     # ------------------------------------------------------------------ checks
-    def validate(self):
+    def structural_diagnostics(self) -> list:
+        """Every structural check as a Diagnostic list (GLS002-GLS005,
+        GLS010), so the CLI linter and the constructing `validate()` report
+        identically. Checks degrade gracefully: a failed prerequisite (e.g.
+        world % pp) skips the checks whose arithmetic it would poison rather
+        than raising mid-collection."""
+        from galvatron_tpu.analysis import diagnostics as D
+
+        out = []
         if self.default_dp_type not in DP_TYPES:
-            raise ValueError("default_dp_type must be one of %s" % (DP_TYPES,))
+            out.append(D.make(
+                "GLS005", "default_dp_type must be one of %s, got %r"
+                % (DP_TYPES, self.default_dp_type), key="default_dp_type",
+            ))
         if self.pipeline_type not in PIPELINE_TYPES:
-            raise ValueError("pipeline_type must be one of %s" % (PIPELINE_TYPES,))
-        if self.world_size % self.pp != 0:
-            raise ValueError("world_size %d not divisible by pp %d" % (self.world_size, self.pp))
+            out.append(D.make(
+                "GLS005", "pipeline_type must be one of %s, got %r"
+                % (PIPELINE_TYPES, self.pipeline_type), key="pipeline_type",
+            ))
+        if self.cp_mode not in CP_MODES:
+            out.append(D.make(
+                "GLS005", "cp_mode must be one of %s, got %r"
+                % (CP_MODES, self.cp_mode), key="cp_mode",
+            ))
+        if self.pp < 1 or self.world_size % self.pp != 0:
+            out.append(D.make(
+                "GLS002", "world_size %d not divisible by pp %d"
+                % (self.world_size, self.pp), key="pp_deg",
+            ))
+            return out  # per-stage arithmetic below would be meaningless
         if len(self.pp_division) != self.pp or sum(self.pp_division) != len(self.layers):
-            raise ValueError(
-                "pp_division %s inconsistent with pp=%d, %d layers"
-                % (self.pp_division, self.pp, len(self.layers))
-            )
+            out.append(D.make(
+                "GLS003", "pp_division %s inconsistent with pp=%d, %d layers"
+                % (self.pp_division, self.pp, len(self.layers)), key="pp_division",
+            ))
+        elif any(n < 1 for n in self.pp_division):
+            out.append(D.make(
+                "GLS003", "every pipeline stage needs >= 1 layer, got %s"
+                % (self.pp_division,), key="pp_division",
+            ))
         per_stage = self.world_size // self.pp
+        dps = []
         for i, s in enumerate(self.layers):
             if per_stage % (s.tp * s.cp) != 0:
-                raise ValueError(
-                    "layer %d: tp*cp=%d does not divide per-stage devices %d"
-                    % (i, s.tp * s.cp, per_stage)
-                )
+                out.append(D.make(
+                    "GLS002", "layer %d: tp*cp=%d does not divide per-stage devices %d"
+                    % (i, s.tp * s.cp, per_stage), layer=i,
+                ))
+            else:
+                dps.append(per_stage // (s.tp * s.cp))
         if per_stage % (self.vocab_tp * self.vocab_cp) != 0:
-            raise ValueError("vocab_tp*vocab_cp must divide per-stage devices")
+            out.append(D.make(
+                "GLS002", "vocab_tp*vocab_cp=%d must divide per-stage devices %d"
+                % (self.vocab_tp * self.vocab_cp, per_stage), key="vtp",
+            ))
+        else:
+            dps.append(per_stage // (self.vocab_tp * self.vocab_cp))
         # batch must divide every layer's dp degree (incl. the vocab layers):
         # the batch dim is sharded over each layer's dp axes (cf. reference
         # assert at hybrid_parallel_config.py:93-96, done there via min_tp)
-        max_dp = max(
-            [per_stage // (s.tp * s.cp) for s in self.layers]
-            + [per_stage // (self.vocab_tp * self.vocab_cp)]
-        )
+        max_dp = max(dps) if dps else 1
         if self.global_bsz % max_dp != 0:
-            raise ValueError(
-                "global_bsz %d must be a multiple of the largest layer dp degree %d"
-                % (self.global_bsz, max_dp)
-            )
+            out.append(D.make(
+                "GLS004", "global_bsz %d must be a multiple of the largest "
+                "layer dp degree %d" % (self.global_bsz, max_dp),
+                key="global_bsz",
+            ))
         # Under the 1F1B schedule the sharded unit is the MICROBATCH, and it
         # must shard EVENLY over every LAYER's dp degree: an uneven batch
         # shard makes GSPMD pad and reshard with collective-permutes, which
@@ -154,19 +266,97 @@ class HybridParallelConfig:
         # and the gpipe scan (uniform code throughout).
         if self.pp > 1 and self.pipeline_type == "pipedream_flush":
             if self.global_bsz % self.chunks != 0:
-                raise ValueError(
-                    "global_bsz %d must divide into %d chunks" % (self.global_bsz, self.chunks)
-                )
-            mb = self.global_bsz // self.chunks
-            max_layer_dp = max(per_stage // (s.tp * s.cp) for s in self.layers)
-            if mb % max_layer_dp != 0:
-                raise ValueError(
-                    "1F1B microbatch size %d (global_bsz %d / chunks %d) must be "
-                    "a multiple of the largest layer dp degree %d"
-                    % (mb, self.global_bsz, self.chunks, max_layer_dp)
-                )
-        if self.cp_mode not in ("ring", "zigzag"):
-            raise ValueError("cp_mode must be 'ring' or 'zigzag', got %r" % (self.cp_mode,))
+                out.append(D.make(
+                    "GLS004", "global_bsz %d must divide into %d chunks"
+                    % (self.global_bsz, self.chunks), key="chunks",
+                ))
+            else:
+                mb = self.global_bsz // self.chunks
+                layer_dps = [
+                    per_stage // (s.tp * s.cp) for s in self.layers
+                    if per_stage % (s.tp * s.cp) == 0
+                ]
+                max_layer_dp = max(layer_dps) if layer_dps else 1
+                if mb % max_layer_dp != 0:
+                    out.append(D.make(
+                        "GLS004", "1F1B microbatch size %d (global_bsz %d / "
+                        "chunks %d) must be a multiple of the largest layer "
+                        "dp degree %d"
+                        % (mb, self.global_bsz, self.chunks, max_layer_dp),
+                        key="chunks",
+                    ))
+        return out
+
+    def pipeline_engine_diagnostics(self) -> list:
+        """Cross-layer mesh-axis consistency within/across pipeline stages
+        (GLS010) and checkpoint legality (GLS011), mirroring the engine-side
+        validators (parallel/pipeline.py asserts, pipeline_1f1b.py
+        validate_1f1b_config) so a bad searched config is refused before any
+        tracing. NOT part of `validate()` — configs destined for pp=1 slicing
+        or custom engines construct fine; the linter (and the engines
+        themselves) enforce these."""
+        from galvatron_tpu.analysis import diagnostics as D
+
+        out = []
+        if self.pp <= 1:
+            return out
+        div = self.pp_division
+        if len(div) != self.pp or sum(div) != len(self.layers) or any(n < 1 for n in div):
+            return out  # GLS003 already reported; stage slicing is undefined
+        stage_sigs = []
+        for st in range(self.pp):
+            stage_sigs.append(tuple(self.layers[i] for i in self.layers_of_stage(st)))
+        if self.pipeline_type == "gpipe":
+            # the vmapped scan body is ONE program: equal stages, identical
+            # within-stage strategies everywhere, no ring cp
+            if len(set(div)) != 1:
+                out.append(D.make(
+                    "GLS010", "gpipe scan requires equal layers per stage, "
+                    "got pp_division %s (use pipeline_type="
+                    "'pipedream_flush' for uneven divisions)" % (div,),
+                    key="pp_division",
+                ))
+            elif len(set(stage_sigs)) != 1:
+                # report checkpoint-only divergence as GLS011 (the remat flag
+                # changes the scanned program), anything else as GLS010
+                ckpt_only = len({
+                    tuple(dataclasses.replace(s, checkpoint=0) for s in sig)
+                    for sig in stage_sigs
+                }) == 1
+                code = "GLS011" if ckpt_only else "GLS010"
+                what = ("activation-checkpoint flags" if ckpt_only
+                        else "layer strategies")
+                out.append(D.make(
+                    code, "gpipe scan requires within-stage %s to match on "
+                    "every stage (the vmapped body is one program); use "
+                    "pipeline_type='pipedream_flush' for per-stage "
+                    "heterogeneous strategies" % what,
+                ))
+            for i, s in enumerate(self.layers):
+                if s.cp > 1:
+                    out.append(D.make(
+                        "GLS010", "layer %d: cp>1 with pp>1 must run through "
+                        "the 1F1B engine (pipeline_type='pipedream_flush'); "
+                        "the scan pipeline computes attention without the "
+                        "ring shard_map" % i, layer=i,
+                    ))
+                    break
+        else:  # pipedream_flush
+            if any(s.cp > 1 for s in self.layers) and len(set(stage_sigs)) != 1:
+                out.append(D.make(
+                    "GLS010", "ring-attention cp>1 inside the 1F1B schedule "
+                    "requires stage-uniform strategies (equal divisions "
+                    "included): the ring's collective-permutes must execute "
+                    "identically on every stage every tick",
+                ))
+        return out
+
+    def validate(self):
+        from galvatron_tpu.analysis import diagnostics as D
+
+        errors = [d for d in self.structural_diagnostics() if d.severity == D.ERROR]
+        if errors:
+            raise D.DiagnosticError(errors)
 
     # -------------------------------------------------------------- properties
     @property
@@ -224,8 +414,17 @@ class HybridParallelConfig:
     @classmethod
     def from_json(cls, path_or_dict, world_size: int, **overrides) -> "HybridParallelConfig":
         """Load a searched strategy JSON in the reference's on-disk format
-        (reference utils/config_utils.py:22-46)."""
+        (reference utils/config_utils.py:22-46). Rejects unknown/typo'd keys
+        and malformed per-layer arrays with structured diagnostics (GLS001/
+        GLS005/GLS006 via DiagnosticError) instead of silently ignoring them
+        — a misspelled key would otherwise fall back to its default and
+        surface minutes later as an OOM or a wrong-parallelism run."""
+        from galvatron_tpu.analysis import diagnostics as D
+
         cfg = path_or_dict if isinstance(path_or_dict, dict) else read_json_config(path_or_dict)
+        schema_errors = [d for d in schema_diagnostics(cfg) if d.severity == D.ERROR]
+        if schema_errors:
+            raise D.DiagnosticError(schema_errors)
         tp_sizes = str2array(cfg["tp_sizes_enc"])
         n = len(tp_sizes)
         cp_sizes = str2array(cfg.get("cp_sizes_enc", array2str([1] * n)))

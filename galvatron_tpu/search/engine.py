@@ -599,6 +599,20 @@ class GalvatronSearchEngine:
 
     def save_results(self, result: dict, path: Optional[str] = None) -> str:
         cfg = self.result_to_config(result)
+        # lint the winner before emitting it: an emitted config must ALWAYS
+        # construct and pass the engine validators at train time — a failure
+        # here is a search-engine bug surfaced at search time, not minutes
+        # into a TPU job. Warnings (resharding runs, inert flags) go to the
+        # task log / stdout.
+        from galvatron_tpu.analysis import strategy_lint as _slint
+
+        report = _slint.lint_hp(cfg)
+        for d in report.warnings:
+            (self.logger.info if self.logger else print)("strategy lint: %s" % d.format())
+        if not report.ok:
+            from galvatron_tpu.analysis.diagnostics import DiagnosticError
+
+            raise DiagnosticError(report.errors)
         path = path or os.path.join(
             self.config_dir,
             "galvatron_config_%s_%dgpus_%dGB_%s.json"

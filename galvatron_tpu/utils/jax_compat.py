@@ -1,0 +1,120 @@
+"""jax 0.4.x compatibility shims.
+
+The codebase targets the modern jax surface (`jax.shard_map` with
+``axis_names=``/``check_vma=``, `jax.sharding.get_abstract_mesh`), but the
+pinned environment ships jax 0.4.37 where those live elsewhere or do not
+exist:
+
+- ``jax.shard_map``            -> ``jax.experimental.shard_map.shard_map``,
+  translating ``axis_names`` (the axes to make Manual) into the old ``auto=``
+  complement and ``check_vma`` into ``check_rep``.
+- ``jax.sharding.get_abstract_mesh`` -> no thread-local mesh context exists on
+  0.4.37 (``jax._src.mesh`` tracks an empty tuple); the fallback returns
+  ``None``, which callers treat as "no context mesh" (see
+  ops/ring_attention.py).
+
+`install()` is idempotent, patches only the *missing* names, and is invoked
+from the package ``__init__`` so every entry point (CLI, tests, notebooks)
+sees a uniform API. On a jax that already provides these names the shim is a
+no-op. The static code linter (analysis/code_lint.py GLC001) resolves
+attribute chains against the *patched* module, so `jax.shard_map` call sites
+lint clean exactly when this shim (or a modern jax) provides them.
+"""
+
+from __future__ import annotations
+
+from functools import wraps
+
+import jax
+
+
+def _shard_map_shim():
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    @wraps(_legacy_shard_map)
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                  axis_names=None, check_vma=None, check_rep=None,
+                  auto=None, **kwargs):
+        """Modern-signature `jax.shard_map` on top of the 0.4.x experimental
+        API. ``axis_names`` lists the mesh axes the body is *manual* over;
+        the legacy API instead takes ``auto`` — the complement."""
+        if auto is None:
+            if axis_names is not None and mesh is not None:
+                auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+            else:
+                auto = frozenset()
+        if check_rep is None:
+            check_rep = bool(check_vma) if check_vma is not None else True
+        if auto:
+            # 0.4.x cannot run the replication checker over partially-auto
+            # meshes (it raises); the modern default is equivalent to off.
+            check_rep = False
+        return _legacy_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_rep, auto=frozenset(auto), **kwargs,
+        )
+
+    return shard_map
+
+
+def _get_abstract_mesh_shim():
+    def get_abstract_mesh():
+        """0.4.x has no use_mesh/abstract-mesh context; report "none" so
+        callers fall back to their explicit concrete mesh."""
+        return None
+
+    return get_abstract_mesh
+
+
+def install() -> None:
+    """Patch the missing modern APIs into the installed jax. Idempotent."""
+    if not hasattr(jax, "shard_map"):
+        jax.shard_map = _shard_map_shim()
+    if not hasattr(jax.sharding, "get_abstract_mesh"):
+        jax.sharding.get_abstract_mesh = _get_abstract_mesh_shim()
+
+
+_PARTIAL_MANUAL: dict = {}
+
+
+def supports_partial_manual_shard_map() -> bool:
+    """Whether this jax can compile a shard_map that is manual over a SUBSET
+    of the mesh axes with a collective inside (the 1F1B engines' shape:
+    manual over 'pp', GSPMD-auto within the stage). jax 0.4.x's legacy
+    ``auto=`` lowering emits a PartitionId op that SPMD partitioning rejects
+    at compile time; modern jax handles it. Probed once per process by
+    compiling a 4-device toy (device_count permitting), not version-matched,
+    so a backport or partial fix flips the answer automatically."""
+    if "ok" in _PARTIAL_MANUAL:
+        return _PARTIAL_MANUAL["ok"]
+    # The probe MUST run out-of-process: on jax 0.4.x some partial-manual
+    # lowerings die in a fatal XLA CHECK (spmd_partitioner.cc
+    # IsManualSubgroup), which would abort the probing process itself.
+    import subprocess
+    import sys
+
+    code = (
+        "import os\n"
+        "os.environ['XLA_FLAGS'] = os.environ.get('XLA_FLAGS', '') "
+        "+ ' --xla_force_host_platform_device_count=4'\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "import numpy as np, jax, jax.numpy as jnp\n"
+        "from jax.experimental.shard_map import shard_map\n"
+        "from jax.sharding import Mesh, PartitionSpec as P\n"
+        "mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ('pp', 'dp'))\n"
+        "f = shard_map(lambda x: jax.lax.ppermute(x, 'pp', [(0, 1), (1, 0)]),\n"
+        "              mesh=mesh, in_specs=P('pp'), out_specs=P('pp'),\n"
+        "              check_rep=False, auto=frozenset({'dp'}))\n"
+        "jax.jit(f).lower(jnp.zeros((4, 4))).compile()\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, timeout=180,
+        )
+        _PARTIAL_MANUAL["ok"] = proc.returncode == 0
+    except Exception:  # noqa: BLE001 - any probe failure means "no"
+        _PARTIAL_MANUAL["ok"] = False
+    return _PARTIAL_MANUAL["ok"]
+
+
+install()

@@ -1,0 +1,10 @@
+"""Calls jax APIs that do not exist in the installed jax."""
+import jax
+import jax.numpy as jnp
+from jax.experimental import definitely_not_a_module  # GLC001
+
+
+def f(x):
+    y = jax.shard_mapp  # GLC001 (typo'd top-level)
+    z = jnp.einsumm("ij->i", x)  # GLC001
+    return jax.sharding.get_abstract_meshh, y, z  # GLC001

@@ -1,0 +1,11 @@
+"""Every chain here resolves on the installed jax (incl. the compat shim)."""
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map  # resolves
+from jax.sharding import Mesh, PartitionSpec
+
+
+def f(x):
+    ctx = jax.sharding.get_abstract_mesh()  # provided by the compat shim
+    y = jax.shard_map  # provided by the compat shim
+    return jnp.einsum("ij->i", x), ctx, y, Mesh, PartitionSpec, shard_map

@@ -1,0 +1,7 @@
+import jax
+import numpy as np
+
+
+@jax.jit
+def f(x):
+    return np.asarray(x).sum()  # GLC002: numpy cannot consume tracers

@@ -1,0 +1,13 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def f(x):
+    scale = np.float32(2.0)  # dtype constructor: a trace-time constant
+    return jnp.asarray(x).sum() * scale + np.pi
+
+
+def host_side(x):
+    return np.asarray(x)  # not jitted: host numpy is fine

@@ -1,0 +1,10 @@
+import jax
+
+
+@jax.jit
+def f(x, threshold):
+    if x.sum() > threshold:  # GLC003: branch on a traced value
+        return x
+    while threshold > 0:  # GLC003
+        threshold = threshold - 1
+    return -x

@@ -1,0 +1,17 @@
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("flag",))
+def f(x, flag):
+    if flag:  # static argument: resolved at trace time by design
+        return x
+    if x is None:  # identity test: static
+        return x
+    if x.shape[0] > 4:  # shape: static under jit
+        return x[:4]
+    if "mask" in {"mask": 1}:  # dict-key membership: pytree structure
+        pass
+    return jnp.where(x > 0, x, -x)  # traced select: the jit-safe form

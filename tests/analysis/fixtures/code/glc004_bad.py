@@ -1,0 +1,14 @@
+import jax
+
+
+def train_step(params, opt_state, batch):
+    return params, opt_state, {"loss": 0.0}
+
+
+step = jax.jit(train_step, donate_argnums=(0, 1))
+
+
+def loop(params, opt_state, batch):
+    new_p, new_s, metrics = step(params, opt_state, batch)
+    stale = params["w"]  # GLC004: params' buffer was donated to step()
+    return new_p, new_s, stale

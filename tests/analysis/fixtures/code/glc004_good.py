@@ -1,0 +1,17 @@
+import jax
+
+
+def train_step(params, opt_state, batch):
+    return params, opt_state, {"loss": 0.0}
+
+
+step = jax.jit(train_step, donate_argnums=(0, 1))
+eval_fn = jax.jit(train_step)  # no donation: reuse is fine
+
+
+def loop(params, opt_state, batch):
+    params, opt_state, metrics = step(params, opt_state, batch)
+    ok = params["w"]  # rebound to the fresh output: safe
+    a, b, m = eval_fn(params, opt_state, batch)
+    also_ok = params["w"]  # eval_fn donates nothing
+    return params, opt_state, ok, also_ok

@@ -1,0 +1,8 @@
+import jax
+import numpy as np
+
+
+@jax.jit
+def f(x):
+    lut = np.arange(4)  # galv-lint: ignore[GLC002] -- trace-time constant table
+    return x + lut.sum()

@@ -1,0 +1,118 @@
+"""CLI contract: `python -m galvatron_tpu.cli lint` exit codes and output
+formats. In-process through `cli.lint.run` (fast); one subprocess test pins
+the real `python -m` wiring."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from galvatron_tpu.cli.lint import run
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def fx(rel):
+    return os.path.join(FIXTURES, rel)
+
+
+def test_valid_corpus_exits_zero(capsys):
+    assert run([fx("valid/uniform_dp8.json"), fx("valid/hybrid_pp2_1f1b.json"),
+                fx("valid/ring_cp_uniform.json"), "--world_size", "8"]) == 0
+    assert "0 error(s)" in capsys.readouterr().out
+
+
+def test_broken_corpus_exits_one(capsys):
+    import glob
+
+    broken = sorted(glob.glob(fx("broken/*.json")))
+    assert broken
+    assert run(broken + ["--world_size", "8"]) == 1
+    out = capsys.readouterr().out
+    assert "GLS001" in out and "GLS010" in out
+
+
+def test_json_output_parses(capsys):
+    assert run([fx("broken/gls005_bad_enum.json"), "--world_size", "8",
+                "--json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["summary"]["errors"] >= 1
+    assert "GLS005" in payload["summary"]["codes"]
+
+
+def test_model_aware_flags_require_model(capsys):
+    # without a model config the heads/tp mismatch is invisible...
+    assert run([fx("broken/gls007_heads_tp.json"), "--world_size", "8"]) == 0
+    capsys.readouterr()
+    # ...and a model family whose heads don't divide tp=4 trips GLS007
+    # (gpt-0.3b has 16 heads -> passes; bert default has 12 -> 12 % 4 == 0;
+    # use swin? keep it simple: llama-7b has 32 heads -> passes). The
+    # per-model check is covered in test_strategy_lint with a crafted
+    # config; here we only pin that --model_type resolves and lints.
+    assert run([fx("broken/gls007_heads_tp.json"), "--world_size", "8",
+                "--model_type", "gpt"]) == 0
+    capsys.readouterr()
+
+
+def test_code_fixtures_through_cli(capsys):
+    assert run([os.path.join(FIXTURES, "code", "glc001_bad.py")]) == 1
+    out = capsys.readouterr().out
+    assert "GLC001" in out
+    assert run([os.path.join(FIXTURES, "code", "glc001_good.py")]) == 0
+    capsys.readouterr()
+
+
+def test_warnings_pass_unless_strict(capsys):
+    args = [fx("warn/gls103_inert_flags.json"), "--world_size", "8"]
+    assert run(args) == 0
+    capsys.readouterr()
+    assert run(args + ["--strict"]) == 1
+    capsys.readouterr()
+
+
+def test_explain_prints_code_table(capsys):
+    assert run(["--explain"]) == 0
+    out = capsys.readouterr().out
+    for code in ("GLS001", "GLS101", "GLC001", "GLC004"):
+        assert code in out
+
+
+def test_usage_error_exits_two(capsys):
+    assert run([]) == 2
+
+
+def test_module_entrypoint_subprocess():
+    """One real `python -m galvatron_tpu.cli lint` run: non-zero on the
+    broken corpus, zero on the shipped valid corpus."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    bad = subprocess.run(
+        [sys.executable, "-m", "galvatron_tpu.cli", "lint",
+         fx("broken/gls002_tp_overflow.json"), "--world_size", "8", "--json"],
+        capture_output=True, text=True, env=env, timeout=120,
+        cwd=os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    )
+    assert bad.returncode == 1, bad.stderr
+    assert json.loads(bad.stdout)["summary"]["errors"] >= 1
+
+
+def test_train_driver_lints_before_tracing(devices8):
+    """The cli/train.py hook: a strategy whose heads don't divide tp is
+    refused by the linter before any compile (DiagnosticError, not an XLA
+    error)."""
+    from galvatron_tpu.analysis.diagnostics import DiagnosticError
+    from galvatron_tpu.cli.arguments import initialize_galvatron
+    from galvatron_tpu.cli.train import train
+
+    args = initialize_galvatron(mode="train", argv=[
+        "--model_type", "gpt", "--set_model_config_manually", "1",
+        "--hidden_size", "96", "--num_attention_heads", "6",
+        "--num_layers", "2", "--seq_length", "64", "--vocab_size", "128",
+        "--global_tp_deg", "4", "--world_size", "8",
+        "--global_train_batch_size", "8", "--train_iters", "1",
+    ])
+    # 6 heads, tp=4 -> 6 % 4 != 0 -> GLS007 raised before tracing starts
+    with pytest.raises(DiagnosticError) as ei:
+        train(args)
+    assert any(d.code == "GLS007" for d in ei.value.diagnostics)

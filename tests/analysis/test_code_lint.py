@@ -1,0 +1,90 @@
+"""Known-bad / known-good snippet corpus for every code-lint rule, plus the
+resolver and pragma machinery."""
+
+import os
+
+import pytest
+
+from galvatron_tpu.analysis import code_lint as C
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "code")
+
+
+def lint_fixture(name, **kw):
+    path = os.path.join(FIXTURES, name)
+    with open(path, "r", encoding="utf-8") as fp:
+        return C.lint_source(fp.read(), filename=path, **kw)
+
+
+RULES = ("GLC001", "GLC002", "GLC003", "GLC004")
+
+
+@pytest.mark.parametrize("code", RULES)
+def test_bad_fixture_flags_good_fixture_clean(code):
+    stem = code.lower()
+    bad = lint_fixture("%s_bad.py" % stem)
+    assert {d.code for d in bad} == {code}, [d.format() for d in bad]
+    good = lint_fixture("%s_good.py" % stem)
+    assert good == [], [d.format() for d in good]
+
+
+def test_glc001_reports_shortest_missing_prefix():
+    ds = lint_fixture("glc001_bad.py")
+    typo = [d for d in ds if "shard_mapp" in d.message]
+    assert typo and "jax.shard_mapp" in typo[0].message
+
+
+def test_glc003_while_and_if_both_flagged():
+    ds = lint_fixture("glc003_bad.py")
+    msgs = " ".join(d.message for d in ds)
+    assert "Python if" in msgs and "Python while" in msgs
+
+
+def test_pragma_suppression():
+    assert lint_fixture("pragma_suppressed.py") == []
+    # the same source without the pragma flags GLC002
+    path = os.path.join(FIXTURES, "pragma_suppressed.py")
+    with open(path) as fp:
+        src = fp.read().replace("# galv-lint: ignore[GLC002] -- trace-time constant table", "")
+    assert {d.code for d in C.lint_source(src, path)} == {"GLC002"}
+
+
+def test_rule_subset_filtering():
+    ds = lint_fixture("glc002_bad.py", rules={"GLC001"})
+    assert ds == []
+
+
+def test_resolver_introspects_installed_jax():
+    r = C.JaxResolver()
+    assert r.missing_prefix(("jax", "numpy", "einsum")) is None
+    assert r.missing_prefix(("jax", "numpy", "einsumm")) == "jax.numpy.einsumm"
+    # submodules that need importing resolve too
+    assert r.missing_prefix(("jax", "experimental", "shard_map", "shard_map")) is None
+    # memoised: second call hits the cache
+    assert r.missing_prefix(("jax", "numpy", "einsumm")) == "jax.numpy.einsumm"
+
+
+def test_compat_shim_names_resolve():
+    """The GLC001 acceptance property: with the jax_compat shim installed
+    (package import), the previously-missing modern APIs resolve."""
+    import jax
+
+    assert hasattr(jax, "shard_map")
+    assert hasattr(jax.sharding, "get_abstract_mesh")
+    r = C.JaxResolver()
+    assert r.missing_prefix(("jax", "shard_map")) is None
+    assert r.missing_prefix(("jax", "sharding", "get_abstract_mesh")) is None
+
+
+def test_syntax_error_is_reported_not_raised():
+    ds = C.lint_source("def f(:\n", "broken_syntax.py")
+    assert len(ds) == 1 and ds[0].code == "GLC001" and "parse" in ds[0].message
+
+
+def test_iter_python_files_skips_pycache(tmp_path):
+    (tmp_path / "a.py").write_text("x = 1\n")
+    pc = tmp_path / "__pycache__"
+    pc.mkdir()
+    (pc / "a.cpython-310.py").write_text("x = 1\n")
+    files = C.iter_python_files([str(tmp_path)])
+    assert [os.path.basename(f) for f in files] == ["a.py"]

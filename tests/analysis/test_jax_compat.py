@@ -1,0 +1,78 @@
+"""The jax 0.4.x compat shim: modern API names exist, translate correctly,
+and the full-manual path actually runs collectives on the virtual mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from galvatron_tpu.utils import jax_compat
+
+
+def test_shim_installed_by_package_import():
+    # importing galvatron_tpu (done transitively above) installs the shims
+    assert hasattr(jax, "shard_map")
+    assert hasattr(jax.sharding, "get_abstract_mesh")
+
+
+def test_install_is_idempotent():
+    before = jax.shard_map
+    jax_compat.install()
+    assert jax.shard_map is before
+
+
+def test_get_abstract_mesh_contract():
+    """Call sites treat `None` (0.4.x shim) and an empty abstract mesh
+    (modern jax) identically: 'no context mesh'."""
+    ctx = jax.sharding.get_abstract_mesh()
+    assert ctx is None or getattr(ctx, "empty", False)
+
+
+def test_shard_map_full_manual_runs(devices8):
+    mesh = Mesh(np.array(devices8).reshape(2, 4), ("pp", "tp"))
+    f = jax.shard_map(
+        lambda x: jax.lax.psum(x, "tp"),
+        mesh=mesh, in_specs=P("pp", "tp"), out_specs=P("pp", None),
+        axis_names={"pp", "tp"}, check_vma=False,
+    )
+    x = jnp.arange(8.0).reshape(2, 4)
+    out = jax.jit(f)(x)
+    np.testing.assert_allclose(np.asarray(out), [[6.0], [22.0]])
+
+
+def test_shard_map_axis_names_accepts_partial_manual_tracing(devices8):
+    """axis_names= (modern, 'the manual axes') translates to auto= (legacy,
+    'the rest'): tracing a partial-manual region must succeed — only the body
+    sees the manually-mapped shape. (Compiling it may be unsupported on
+    0.4.x, which `supports_partial_manual_shard_map` reports.)"""
+    mesh = Mesh(np.array(devices8).reshape(2, 4), ("pp", "tp"))
+    shapes = []
+
+    def body(x):
+        shapes.append(x.shape)
+        return x * 2.0
+
+    f = jax.shard_map(
+        body, mesh=mesh, in_specs=P("pp"), out_specs=P("pp"),
+        axis_names={"pp"}, check_vma=False,
+    )
+    jax.make_jaxpr(f)(jnp.zeros((4, 4)))
+    # manual over pp (2) only: the per-shard block is 4/2 x 4, NOT 4/8
+    assert shapes == [(2, 4)]
+
+
+def test_partial_manual_probe_is_cached_and_boolean():
+    v = jax_compat.supports_partial_manual_shard_map()
+    assert isinstance(v, bool)
+    assert jax_compat.supports_partial_manual_shard_map() is v
+
+
+def test_ring_attention_imports_without_attributeerror():
+    """The acceptance property: the modules the missing APIs used to break
+    at import/trace time now import cleanly."""
+    import galvatron_tpu.ops.ring_attention  # noqa: F401
+    import galvatron_tpu.parallel.pipeline_1f1b  # noqa: F401
+    import galvatron_tpu.parallel.pipeline_1f1b_encdec  # noqa: F401
+    import galvatron_tpu.parallel.pipeline_1f1b_swin  # noqa: F401
+    import galvatron_tpu.profiler.hardware  # noqa: F401

@@ -1,0 +1,143 @@
+"""Golden-corpus tests: every GLS diagnostic code has at least one failing
+fixture (tests/analysis/fixtures/broken|warn) and one passing fixture
+(tests/analysis/fixtures/valid, linted under the same options)."""
+
+import glob
+import os
+
+import pytest
+
+from galvatron_tpu.analysis import strategy_lint as S
+from galvatron_tpu.analysis.diagnostics import ERROR, WARNING
+from galvatron_tpu.models.base import TransformerConfig
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+WORLD = 8
+
+# small model whose dimensions deliberately don't divide the broken corpus's
+# degrees: heads=6 (not %4), seq=100 (not %8), vocab=100 (not %8)
+MODEL = TransformerConfig(
+    hidden_size=96, num_heads=6, num_layers=4, vocab_size=100, max_seq_len=100,
+)
+
+
+def lint(rel, **kw):
+    return S.lint_strategy_file(os.path.join(FIXTURES, rel), WORLD, **kw)
+
+
+# code -> (broken fixture, lint kwargs)
+BROKEN = {
+    "GLS001": ("broken/gls001_typo_key.json", {}),
+    "GLS002": ("broken/gls002_tp_overflow.json", {}),
+    "GLS003": ("broken/gls003_bad_division.json", {}),
+    "GLS004": ("broken/gls004_bad_bsz.json", {}),
+    "GLS005": ("broken/gls005_bad_enum.json", {}),
+    "GLS006": ("broken/gls006_len_mismatch.json", {}),
+    "GLS007": ("broken/gls007_heads_tp.json", {"model_cfg": MODEL}),
+    "GLS008": ("broken/gls008_seq_cp.json", {"model_cfg": MODEL}),
+    "GLS009": ("broken/gls009_vocab_tp.json", {"model_cfg": MODEL}),
+    "GLS010": ("broken/gls010_gpipe_nonuniform.json", {}),
+    "GLS011": ("broken/gls011_ckpt_nonuniform.json", {}),
+}
+WARN = {
+    "GLS101": ("warn/gls101_over_budget.json",
+               {"model_cfg": MODEL, "memory_budget_gb": 0.0001}),
+    "GLS102": ("warn/gls102_reshard.json", {}),
+    "GLS103": ("warn/gls103_inert_flags.json", {}),
+}
+
+
+@pytest.mark.parametrize("code", sorted(BROKEN))
+def test_broken_fixture_fails_with_code(code):
+    rel, kw = BROKEN[code]
+    report = lint(rel, **kw)
+    assert not report.ok, "expected errors for %s" % rel
+    assert code in report.codes(), (code, report.render())
+    assert report.exit_code() == 1
+    # location metadata survives into the report
+    assert all(d.file.endswith(rel.split("/")[-1]) for d in report.diagnostics)
+
+
+@pytest.mark.parametrize("code", sorted(WARN))
+def test_warn_fixture_warns_with_code(code):
+    rel, kw = WARN[code]
+    report = lint(rel, **kw)
+    assert report.ok, report.render()  # warnings never fail the exit code
+    assert code in {d.code for d in report.warnings}, report.render()
+    assert report.exit_code() == 0
+
+
+@pytest.mark.parametrize(
+    "rel", sorted(os.path.relpath(p, FIXTURES)
+                  for p in glob.glob(os.path.join(FIXTURES, "valid", "*.json")))
+)
+def test_valid_corpus_is_diagnostic_clean(rel):
+    """The passing side of every code: the valid corpus is clean even under
+    the strictest options the broken corpus is linted with."""
+    report = lint(rel, model_cfg=None)
+    assert report.ok and not report.warnings, report.render()
+
+
+def test_valid_corpus_clean_with_model_and_budget():
+    """GLS007/8/9 and GLS101 have passing fixtures too: a model config whose
+    dims divide (tp=1 everywhere) and a generous budget produce nothing."""
+    report = lint("valid/uniform_dp8.json", model_cfg=MODEL,
+                  memory_budget_gb=1024.0)
+    assert report.ok and not report.warnings, report.render()
+
+
+def test_ring_nonuniform_second_gls010_variant():
+    report = lint("broken/gls010_ring_nonuniform.json")
+    assert "GLS010" in report.codes() and not report.ok
+
+
+def test_gpipe_cp_is_gls010():
+    report = S.lint_strategy_dict(
+        {"pp_deg": 2, "tp_sizes_enc": "1,1,1,1", "cp_sizes_enc": "2,2,2,2",
+         "dp_types_enc": "0,0,0,0", "global_bsz": 8, "chunks": 2,
+         "pipeline_type": "gpipe"}, WORLD)
+    assert "GLS010" in report.codes() and not report.ok
+
+
+def test_did_you_mean_hint_attached():
+    report = lint("broken/gls001_typo_key.json")
+    [d] = [d for d in report.diagnostics if d.code == "GLS001"]
+    assert d.hint and "dp_types_enc" in d.hint
+
+
+def test_json_report_schema():
+    import json
+
+    report = lint("broken/gls002_tp_overflow.json")
+    payload = json.loads(report.to_json())
+    assert payload["version"] == 1
+    assert payload["summary"]["errors"] >= 1
+    assert payload["summary"]["codes"] == report.codes()
+    assert all({"code", "severity", "message"} <= set(d) for d in payload["diagnostics"])
+    assert all(d["severity"] in (ERROR, WARNING) for d in payload["diagnostics"])
+
+
+def test_memory_estimate_profiled_tables_beat_analytic():
+    """GLS101 accepts the profiler's memory JSON; a profile claiming huge
+    layers trips a budget the analytic estimate of the tiny model never
+    would."""
+    profile = {"layertype_0": {
+        "parameter_size": 4096.0,  # MB per layer: a deliberately huge claim
+        "tp_activation_per_bsz_dict": {"1": 512.0, "2": 256.0, "checkpoint": 64.0},
+    }}
+    over = lint("valid/uniform_dp8.json", model_cfg=MODEL, memory_budget_gb=4.0,
+                memory_profile=profile)
+    assert "GLS101" in {d.code for d in over.warnings}, over.render()
+    under = lint("valid/uniform_dp8.json", model_cfg=MODEL, memory_budget_gb=4.0)
+    assert "GLS101" not in {d.code for d in under.warnings}, under.render()
+
+
+def test_estimate_stage_memory_shape():
+    from galvatron_tpu.config.strategy import HybridParallelConfig
+
+    hp = HybridParallelConfig.uniform(8, 4, pp=2, global_bsz=8, chunks=2,
+                                      pipeline_type="pipedream_flush")
+    mb = S.estimate_stage_memory_mb(hp, MODEL)
+    assert mb is not None and len(mb) == 2 and all(m > 0 for m in mb)
+    # no model, no profile -> not enough information, not a guess
+    assert S.estimate_stage_memory_mb(hp, None) is None

@@ -306,6 +306,10 @@ def test_flash_segment_ids_match_xla_padding(causal):
     both schemes and masked downstream)."""
     import jax.experimental.pallas.tpu as pltpu
 
+    if not hasattr(pltpu, "force_tpu_interpret_mode"):
+        pytest.skip("pallas interpret-mode context manager not in this jax "
+                    "(0.4.x); kernel-vs-XLA parity needs it on a CPU host")
+
     from galvatron_tpu.ops.attention import (
         _pallas_flash,
         _xla_attention,

@@ -18,6 +18,13 @@ from galvatron_tpu.runtime.model_api import construct_hybrid_parallel_model
 
 pytestmark = [pytest.mark.parallel, pytest.mark.distributed]
 
+from tests.conftest import requires_partial_manual_shard_map
+
+# jax 0.4.x cannot compile the engines' partial-manual shard_map regions
+# (see tests/conftest.py); probed once per session, auto-re-enables on a
+# capable jax
+_PARTIAL_MANUAL = requires_partial_manual_shard_map()
+
 from tests.conftest import gpt_traj as _traj  # shared baseline machinery
 
 B, S, V = 8, 32, 128
@@ -82,6 +89,7 @@ _EXT = pytest.mark.skipif(
     "pp,tp,chunks",
     [(2, 1, 2), pytest.param(4, 1, 4, marks=_EXT), (2, 2, 4)],
 )
+@_PARTIAL_MANUAL
 def test_1f1b_matches_dp(cfg, params, gpt_ref_traj, devices8, pp, tp, chunks):
     ref = gpt_ref_traj(chunks)
     hp = HybridParallelConfig.uniform(
@@ -95,6 +103,7 @@ def test_1f1b_matches_dp(cfg, params, gpt_ref_traj, devices8, pp, tp, chunks):
     assert max(abs(a - b) for a, b in zip(ref, got)) < 2.5e-4, (ref, got)
 
 
+@_PARTIAL_MANUAL
 def test_1f1b_heterogeneous_stages(cfg, params, gpt_ref_traj, devices8):
     """Per-stage strategies differ (stage 0: tp=2 + remat, stage 1: dp + ZeRO-3)
     — the configuration class the gpipe scan rejects
@@ -112,6 +121,7 @@ def test_1f1b_heterogeneous_stages(cfg, params, gpt_ref_traj, devices8):
     assert max(abs(a - b) for a, b in zip(ref, got)) < 5e-5, (ref, got)
 
 
+@_PARTIAL_MANUAL
 def test_1f1b_bert_masks_match_single_stage(devices8):
     """mlm head + token types + padding attn mask + loss mask under 1F1B."""
     from galvatron_tpu.models.bert import bert_config
@@ -140,6 +150,7 @@ def test_1f1b_bert_masks_match_single_stage(devices8):
     assert abs(got - ref) < 1e-4, (got, ref)
 
 
+@_PARTIAL_MANUAL
 def test_1f1b_vit_classification(devices8):
     from galvatron_tpu.models.vit import vit_config
 
@@ -163,6 +174,7 @@ def test_1f1b_vit_classification(devices8):
 
 
 # ------------------------------------------------------------- memory bound
+@_PARTIAL_MANUAL
 def test_1f1b_peak_memory_below_gpipe(devices8):
     """The 1F1B watermark (bounded stash) must beat the gpipe scan's
     (all-chunks residuals) at pp=4, chunks=8 — the reference's motivation for
@@ -188,6 +200,7 @@ def test_1f1b_peak_memory_below_gpipe(devices8):
     assert f1b < 0.75 * gpipe, (f1b, gpipe)
 
 
+@_PARTIAL_MANUAL
 def test_1f1b_uneven_division_matches_dp(cfg, params, gpt_ref_traj, devices8):
     """Uneven pp_division ([1, 3]) through the 1F1B engine: short stages hold
     zero-padded trailing slots their switch body statically skips (reference

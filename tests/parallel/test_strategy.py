@@ -77,6 +77,52 @@ def test_reference_format_json(tmp_path):
     assert cfg.dp(0) == 8
 
 
+def test_from_json_rejects_unknown_keys(tmp_path):
+    """from_json hardening: a typo'd key fails loudly with a structured
+    GLS001 diagnostic and a did-you-mean hint instead of silently falling
+    back to the default (the old behavior trained the WRONG parallelism)."""
+    from galvatron_tpu.analysis.diagnostics import DiagnosticError
+
+    ref = {
+        "pp_deg": 1,
+        "tp_sizes_enc": "1,1",
+        "dp_types_enc": "0,0",
+        "global_bsz": 8,
+        "tp_consecutive_flag": "1,1",  # typo: missing trailing 's'
+    }
+    with pytest.raises(DiagnosticError) as ei:
+        HybridParallelConfig.from_json(ref, world_size=8)
+    [d] = ei.value.diagnostics
+    assert d.code == "GLS001" and d.key == "tp_consecutive_flag"
+    assert "tp_consecutive_flags" in (d.hint or "")
+    # DiagnosticError is a ValueError: legacy callers' handling still works
+    assert isinstance(ei.value, ValueError)
+
+
+def test_from_json_rejects_length_mismatch():
+    from galvatron_tpu.analysis.diagnostics import DiagnosticError
+
+    with pytest.raises(DiagnosticError) as ei:
+        HybridParallelConfig.from_json(
+            {"pp_deg": 1, "tp_sizes_enc": "1,1,1,1", "dp_types_enc": "0,0"},
+            world_size=8,
+        )
+    assert {d.code for d in ei.value.diagnostics} == {"GLS006"}
+
+
+def test_validate_carries_diagnostic_codes():
+    """validate() errors are routed through the shared diagnostic codes, so
+    the CLI linter and the constructor report identically."""
+    from galvatron_tpu.analysis.diagnostics import DiagnosticError
+
+    with pytest.raises(DiagnosticError) as ei:
+        HybridParallelConfig.uniform(world_size=8, num_layers=2, tp=3)
+    assert any(d.code == "GLS002" for d in ei.value.diagnostics)
+    with pytest.raises(DiagnosticError) as ei:
+        HybridParallelConfig.uniform(world_size=8, num_layers=2, global_bsz=3)
+    assert any(d.code == "GLS004" for d in ei.value.diagnostics)
+
+
 def test_fa_families_pin_flash_attention():
     """gpt_fa / llama_fa (reference flash-attn-native variants) resolve to the
     same configs with attn_impl pinned to the pallas flash kernel."""

@@ -13,6 +13,13 @@ from galvatron_tpu.profiler.validate import validate_memory
 
 pytestmark = [pytest.mark.profiler]
 
+from tests.conftest import requires_partial_manual_shard_map
+
+# jax 0.4.x cannot compile the engines' partial-manual shard_map regions
+# (see tests/conftest.py); probed once per session, auto-re-enables on a
+# capable jax
+_PARTIAL_MANUAL = requires_partial_manual_shard_map()
+
 
 @pytest.fixture(scope="module")
 def cfg():
@@ -52,6 +59,7 @@ def test_prediction_within_2x_of_compiled(cfg, memory_config, kw, devices8):
      dict(pp=4, chunks=4), dict(pp=2, chunks=2, checkpoint=1)],
     ids=["pp2", "pp2_tp2", "pp4", "pp2_ckpt"],
 )
+@_PARTIAL_MANUAL
 def test_1f1b_prediction_within_20pct(cfg, memory_config, kw, devices8):
     """North-star metric #2 for the schedule the search actually emits: the
     1F1B memory model (stash + engine buffers + replicated-grad states +
@@ -110,6 +118,7 @@ def hw_profiles(devices8):
 
 @pytest.mark.parametrize("kw", [dict(pp=2, chunks=2), dict(pp=4, chunks=4)],
                          ids=["pp2", "pp4"])
+@_PARTIAL_MANUAL
 def test_time_prediction_pipedream(cfg, time_config, memory_config, hw_profiles,
                                    kw, devices8):
     """Predicted-vs-measured STEP TIME, the TimeCostModel analogue of the

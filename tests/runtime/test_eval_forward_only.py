@@ -24,6 +24,13 @@ from galvatron_tpu.runtime import construct_hybrid_parallel_model
 
 pytestmark = [pytest.mark.parallel, pytest.mark.distributed]
 
+from tests.conftest import requires_partial_manual_shard_map
+
+# jax 0.4.x cannot compile the engines' partial-manual shard_map regions
+# (see tests/conftest.py); probed once per session, auto-re-enables on a
+# capable jax
+_PARTIAL_MANUAL = requires_partial_manual_shard_map()
+
 B = 8
 
 
@@ -51,6 +58,7 @@ def _flops(fn, *args):
     return float(an.get("flops", 0.0))
 
 
+@_PARTIAL_MANUAL
 def test_gpt_pp2_eval_matches_and_compiles_no_backward(devices8):
     hp = HybridParallelConfig(
         world_size=8, pp=2,
@@ -85,6 +93,7 @@ def test_gpt_uneven_pp_falls_back_to_schedule_loss(devices8):
     assert m.eval_loss is m.loss_fn
 
 
+@_PARTIAL_MANUAL
 def test_t5_pp2_eval_matches(devices8):
     from galvatron_tpu.models.t5 import construct_t5_model, t5_config, t5_pad_batch
 
@@ -116,6 +125,7 @@ def test_t5_pp2_eval_matches(devices8):
     np.testing.assert_allclose(eval_loss, train_loss, rtol=1e-5, atol=1e-6)
 
 
+@_PARTIAL_MANUAL
 def test_swin_pp2_eval_matches(devices8):
     from galvatron_tpu.models.swin import construct_swin_model, swin_config
 
