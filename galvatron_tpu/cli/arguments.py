@@ -57,9 +57,29 @@ def _add_parallel_args(p: argparse.ArgumentParser):
     g.add_argument("--sequence-parallel", dest="sequence_parallel", action="store_true", default=True)
     g.add_argument("--no-sequence-parallel", dest="sequence_parallel", action="store_false")
     g.add_argument("--checkpoint", type=int, default=0, help="1 => activation remat on every layer")
+    g.add_argument("--no_scan_layers", dest="scan_layers", action="store_false", default=True,
+                   help="disable stacking same-strategy layer runs into lax.scan "
+                        "(falls back to unrolled per-layer tracing; compile "
+                        "time grows with depth again)")
+    g.add_argument("--remat_policy", type=str, default="full",
+                   choices=("none", "full", "dots_saveable", "nothing_saveable"),
+                   help="jax.checkpoint policy for layers with checkpoint=1: "
+                        "'full' remats everything (default), 'dots_saveable' "
+                        "keeps matmul outputs resident, 'none' disables the "
+                        "checkpoint flags entirely")
     g.add_argument("--galvatron_config_path", type=str, default=None,
                    help="searched per-layer strategy JSON; overrides the GLOBAL flags above")
     g.add_argument("--world_size", type=int, default=None, help="devices to use (default: all)")
+
+
+def _add_compile_args(p: argparse.ArgumentParser):
+    g = p.add_argument_group("compilation")
+    g.add_argument("--compile_cache", type=int, default=0,
+                   help="1 => enable jax's persistent compilation cache so "
+                        "re-launches with unchanged step HLO skip XLA "
+                        "entirely (per-host cache; see utils/compile_cache.py)")
+    g.add_argument("--compile_cache_dir", type=str, default=None,
+                   help="cache location (default ~/.cache/galvatron_tpu/xla)")
 
 
 def _add_train_args(p: argparse.ArgumentParser):
@@ -209,6 +229,7 @@ def build_parser(mode: str, extra_args_provider: Optional[Callable] = None) -> a
     _add_model_args(p)
     if mode in ("train", "train_dist"):
         _add_parallel_args(p)
+        _add_compile_args(p)
         _add_train_args(p)
         _add_profile_args(p)  # train runs double as profiling runs (reference model_profiler launches train_dist)
     elif mode == "search":
@@ -251,10 +272,16 @@ def hp_config_from_args(args, num_layers: int, world_size: int):
     get_hybrid_parallel_configs_api's two modes, hybrid_parallel_config.py:17-158)."""
     from galvatron_tpu.config.strategy import HybridParallelConfig
 
+    # runtime execution knobs (not part of the searched on-disk schema)
+    exec_kw = dict(
+        scan_layers=getattr(args, "scan_layers", True),
+        remat_policy=getattr(args, "remat_policy", "full"),
+    )
     if getattr(args, "galvatron_config_path", None):
         return HybridParallelConfig.from_json(
             args.galvatron_config_path, world_size=world_size,
             global_bsz=args.global_train_batch_size, mixed_precision=args.mixed_precision,
+            **exec_kw,
         )
     return HybridParallelConfig.uniform(
         world_size=world_size,
@@ -276,6 +303,7 @@ def hp_config_from_args(args, num_layers: int, world_size: int):
         mixed_precision=args.mixed_precision,
         sequence_parallel=args.sequence_parallel,
         cp_mode=args.cp_mode,
+        **exec_kw,
     )
 
 

@@ -43,6 +43,13 @@ from galvatron_tpu.utils.strategy_utils import array2str, str2array
 DP_TYPES = ("ddp", "zero2", "zero3")
 PIPELINE_TYPES = ("gpipe", "pipedream_flush")
 CP_MODES = ("ring", "zigzag")
+# jax.checkpoint policy applied to layers with checkpoint=1 (models/base.py
+# _remat): "full" is jax.checkpoint's default (save nothing, remat
+# everything — the reference's --checkpoint semantics), "none" disables the
+# per-layer checkpoint flags entirely, the *_saveable names select the
+# matching jax.checkpoint_policies member (dots_saveable keeps matmul
+# outputs resident and remats only the cheap elementwise chains).
+REMAT_POLICIES = ("none", "full", "dots_saveable", "nothing_saveable")
 
 # The reference-compatible on-disk schema (from_json/to_json_dict). Split by
 # shape so the schema linter can check lengths/types uniformly.
@@ -146,6 +153,54 @@ class LayerStrategy:
         return self.cp * (self.tp if self.sp else 1)
 
 
+@dataclass(frozen=True)
+class LayerRun:
+    """A maximal run of consecutive layers that compile to ONE program: every
+    layer in [start, stop) has the same mesh-axis assignment (LayerAxes),
+    the same activation-checkpoint flag, and lives on the same pipeline
+    stage. The runtime executes a run of length >= 2 as a single
+    `jax.lax.scan` over weight-stacked params (models/base.py run_layers),
+    so trace/compile cost is per-RUN, not per-layer."""
+
+    start: int
+    stop: int  # exclusive
+    strategy: LayerStrategy  # the run's shared strategy (first layer's)
+
+    @property
+    def length(self) -> int:
+        return self.stop - self.start
+
+    @property
+    def layer_indices(self) -> range:
+        return range(self.start, self.stop)
+
+
+def layer_runs(config: "HybridParallelConfig") -> List[LayerRun]:
+    """Partition ``config.layers`` into maximal scannable runs.
+
+    Layers are grouped by the *realised* strategy — the LayerAxes their
+    LayerStrategy maps to on this mesh — not by raw LayerStrategy equality,
+    so inert flag differences (e.g. ``sp`` or ``tp_consec`` at tp=1) do not
+    split a run. The checkpoint flag partitions (it changes the scanned
+    program) and runs never span a pipeline-stage boundary. Searched
+    strategies are piecewise-uniform in practice (PAPER.md), so this
+    typically yields a handful of runs regardless of depth."""
+    # lazy: parallel.mesh imports this module at top level
+    from galvatron_tpu.parallel.mesh import layer_axes
+
+    stage_of = config.stage_of_layer
+    out: List[LayerRun] = []
+    prev_key = None
+    for i in range(config.num_layers):
+        key = (layer_axes(config, i), config.layers[i].checkpoint, stage_of[i])
+        if out and key == prev_key:
+            out[-1] = dataclasses.replace(out[-1], stop=i + 1)
+        else:
+            out.append(LayerRun(start=i, stop=i + 1, strategy=config.layers[i]))
+        prev_key = key
+    return out
+
+
 def even_pp_division(total_layers: int, pp: int) -> List[int]:
     """Default layer division across pipeline stages (reference
     hybrid_parallel_config.py:86-89: equal with remainder on last stage)."""
@@ -182,6 +237,11 @@ class HybridParallelConfig:
     cp_mode: str = "zigzag"  # ring | zigzag — zigzag applies the balanced data
     # layout as a global sequence permutation in the input pipeline
     # (reference --cp_mode, runtime/arguments.py; redistribute.py:8-44)
+    # Runtime execution knobs (like mixed_precision/sequence_parallel, these
+    # are NOT part of the searched on-disk strategy schema):
+    scan_layers: bool = True  # stack same-strategy layer runs into lax.scan
+    # (depth-constant trace/compile cost); False = unroll every layer
+    remat_policy: str = "full"  # REMAT_POLICIES: policy for checkpoint=1 layers
 
     def __post_init__(self):
         if self.pp_division is None:
@@ -212,6 +272,12 @@ class HybridParallelConfig:
             out.append(D.make(
                 "GLS005", "cp_mode must be one of %s, got %r"
                 % (CP_MODES, self.cp_mode), key="cp_mode",
+            ))
+        if self.remat_policy not in REMAT_POLICIES:
+            out.append(D.make(
+                "GLS005", "remat_policy must be one of %s, got %r"
+                % (REMAT_POLICIES, self.remat_policy), key="remat_policy",
+                hint=D.did_you_mean(str(self.remat_policy), REMAT_POLICIES),
             ))
         if self.pp < 1 or self.world_size % self.pp != 0:
             out.append(D.make(

@@ -26,7 +26,12 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from galvatron_tpu.config.strategy import HybridParallelConfig
+from galvatron_tpu.config.strategy import (
+    HybridParallelConfig,
+    LayerRun,
+    LayerStrategy,
+    layer_runs,
+)
 from galvatron_tpu.ops.attention import core_attention
 from galvatron_tpu.ops.norms import layer_norm, rms_norm
 from galvatron_tpu.ops.rope import apply_rotary
@@ -427,6 +432,45 @@ def vocab_parallel_cross_entropy(logits: jax.Array, labels: jax.Array,
     return jnp.sum(losses * loss_mask) / jnp.maximum(jnp.sum(loss_mask), 1.0)
 
 
+# ------------------------------------------------- scan-over-layer-runs
+def _remat(fn, policy: str):
+    """jax.checkpoint with the configured saveable policy. "full" (and the
+    caller-filtered "none") is jax.checkpoint's default — save nothing,
+    rematerialise everything; the other names select the matching
+    jax.checkpoint_policies member."""
+    if policy in ("full", "none"):
+        return jax.checkpoint(fn)
+    from jax import checkpoint_policies as _policies
+
+    return jax.checkpoint(fn, policy=getattr(_policies, policy))
+
+
+def stack_layer_run(layer_params: List[Params]) -> Params:
+    """Stack a run's per-layer param trees along a new leading layer axis.
+
+    `jnp.stack` (expand_dims per layer + one concatenate along the NEW,
+    never-sharded axis) and not the cheaper concatenate-then-reshape trick:
+    reshape-splitting a dim that is tp-sharded (the row-parallel `wo` /
+    `wo_mlp` kernels, P(tp, ...)) MISCOMPILES in the GSPMD partitioner
+    inside a scan on jax 0.4.37 XLA:CPU — silently wrong layer outputs, not
+    an error. The per-layer expand_dims are pure layout equations; XLA
+    compile time stays governed by the per-RUN body, which is what the
+    trace-cost test asserts (tests/models/test_scan_layers.py)."""
+    if len(layer_params) == 1:
+        return jax.tree.map(lambda t: t[None], layer_params[0])
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *layer_params)
+
+
+def stacked_layer_param_specs(cfg: TransformerConfig, axes: LayerAxes) -> Params:
+    """layer_param_specs with an unsharded leading layer axis, matching
+    stack_layer_run's layout (every layer of the run shares `axes`, so the
+    per-layer spec is prefix-extended verbatim)."""
+    return jax.tree.map(
+        lambda sp: P(None, *sp), layer_param_specs(cfg, axes),
+        is_leaf=lambda t: isinstance(t, P),
+    )
+
+
 def run_layers(
     params: Params,
     x: jax.Array,
@@ -435,17 +479,62 @@ def run_layers(
     hp: Optional[HybridParallelConfig] = None,
     mesh: Optional[Mesh] = None,
     attn_bias: Optional[jax.Array] = None,
+    scan: Optional[bool] = None,
 ) -> jax.Array:
-    """The encoder stack with per-layer sharding constraints and remat."""
+    """The encoder stack with per-layer sharding constraints and remat.
+
+    Layers are partitioned into maximal same-strategy runs
+    (config/strategy.layer_runs); each run of length >= 2 executes as ONE
+    `jax.lax.scan` over weight-stacked params, so trace size and XLA compile
+    time are proportional to the number of DISTINCT strategies, not to
+    depth. Strategy boundaries and length-1 runs fall back to the unrolled
+    per-layer path; `scan=False` (or `hp.scan_layers=False`, the
+    `--no_scan_layers` escape hatch) unrolls everything, reproducing the
+    pre-scan trace exactly."""
     use_hp = hp is not None and mesh is not None
-    for i, lp in enumerate(params["layers"]):
-        axes = layer_axes(hp, i) if use_hp else None
+    layers = params["layers"]
+    if scan is None:
+        scan = hp.scan_layers if hp is not None else True
+    policy = hp.remat_policy if hp is not None else "full"
+
+    def unrolled(x, indices):
+        for i in indices:
+            lp = layers[i]
+            axes = layer_axes(hp, i) if use_hp else None
+            if use_hp:
+                x = S.constrain(x, mesh, S.act_spec(axes))
+            fwd = partial(layer_forward, cfg=cfg, mesh=mesh, axes=axes, attn_bias=attn_bias)
+            if use_hp and hp.layers[i].checkpoint and policy != "none":
+                fwd = _remat(fwd, policy)
+            x = fwd(lp, x, positions)
+        return x
+
+    if use_hp:
+        runs = layer_runs(hp)
+    else:
+        # no strategy info: the whole stack is one homogeneous run
+        runs = [LayerRun(start=0, stop=len(layers), strategy=LayerStrategy())]
+    for run in runs:
+        if not scan or run.length < 2:
+            x = unrolled(x, run.layer_indices)
+            continue
+        axes = layer_axes(hp, run.start) if use_hp else None
+        stacked = stack_layer_run([layers[i] for i in run.layer_indices])
         if use_hp:
-            x = S.constrain(x, mesh, S.act_spec(axes))
-        fwd = partial(layer_forward, cfg=cfg, mesh=mesh, axes=axes, attn_bias=attn_bias)
-        if use_hp and hp.layers[i].checkpoint:
-            fwd = jax.checkpoint(fwd)
-        x = fwd(lp, x, positions)
+            stacked = jax.tree.map(
+                lambda t, sp: S.constrain(t, mesh, sp),
+                stacked, stacked_layer_param_specs(cfg, axes),
+            )
+        body = partial(layer_forward, cfg=cfg, mesh=mesh, axes=axes, attn_bias=attn_bias)
+        if use_hp and run.strategy.checkpoint and policy != "none":
+            body = _remat(body, policy)
+
+        def step(carry, lp, _body=body, _axes=axes):
+            if use_hp:
+                carry = S.constrain(carry, mesh, S.act_spec(_axes))
+            return _body(lp, carry, positions), None
+
+        x, _ = jax.lax.scan(step, x, stacked)
     return x
 
 

@@ -81,6 +81,8 @@ class RuntimeProfiler:
     resilience_counters: Optional[Dict[str, int]] = None  # set by the train
     # driver (runtime/resilience.py ResilienceCounters.as_dict()): anomalies
     # skipped, rollbacks, I/O retries, emergency saves, torn checkpoints
+    trace_ms: Optional[float] = None  # step-fn trace (lower) walltime
+    compile_ms: Optional[float] = None  # XLA compile walltime of the step
     _iter: int = 0
 
     # ------------------------------------------------------------------ timing
@@ -99,6 +101,18 @@ class RuntimeProfiler:
             self.iter_times_ms.append(dt)
             self.samples.append(n_samples)
         return dt
+
+    def record_compile(self, trace_ms: Optional[float] = None,
+                       compile_ms: Optional[float] = None):
+        """Record the one-off trace/compile cost of the jitted train step
+        (cli/train.py AOT-lowers and compiles the step explicitly), so the
+        summary separates program-build cost from steady-state step time —
+        under scan-over-layer-runs the former is depth-constant and this is
+        where the win shows up."""
+        if trace_ms is not None:
+            self.trace_ms = float(trace_ms)
+        if compile_ms is not None:
+            self.compile_ms = float(compile_ms)
 
     # ------------------------------------------------------------------ memory
     def profile_memory(self, iteration: int, stage: str = ""):
@@ -123,10 +137,17 @@ class RuntimeProfiler:
             out = {
                 "avg_iter_ms": avg,
                 "p50_iter_ms": float(np.percentile(self.iter_times_ms, 50)),
+                # alias: the steady-state step time, to read alongside the
+                # one-off trace_ms/compile_ms program-build costs
+                "steady_step_ms": float(np.percentile(self.iter_times_ms, 50)),
                 "samples_per_s": tput,
                 "peak_hbm_mb": peak / 2**20,
                 "iters": len(self.iter_times_ms),
             }
+        if self.trace_ms is not None:
+            out["trace_ms"] = self.trace_ms
+        if self.compile_ms is not None:
+            out["compile_ms"] = self.compile_ms
         if self.resilience_counters is not None:
             out["resilience"] = dict(self.resilience_counters)
         return out

@@ -1,0 +1,40 @@
+"""Opt-in persistent XLA compilation cache.
+
+Scan-over-layer-runs (models/base.py run_layers) makes compile cost
+depth-constant; this module removes it across PROCESS restarts too: with the
+cache enabled, a re-launched train/bench run whose step HLO is unchanged
+loads the compiled executable from disk instead of re-invoking XLA.
+
+Opt-in (``--compile_cache 1`` on the train CLI,
+``GALVATRON_BENCH_COMPILE_CACHE=1`` for bench.py) because the cache is
+per-HOST state: XLA:CPU AOT entries embed the writing host's ISA features
+(cpu_aot_loader.cc), so a cache dir shared across heterogeneous machines
+risks SIGILL on load — keep the default location on local disk and do not
+point it at a network share used by different hosts (the same hazard note as
+tests/conftest.py's session-fresh cache).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+DEFAULT_CACHE_DIR = "~/.cache/galvatron_tpu/xla"
+
+
+def enable_persistent_cache(cache_dir: Optional[str] = None) -> str:
+    """Point jax's persistent compilation cache at `cache_dir` (created if
+    missing; default ~/.cache/galvatron_tpu/xla) and lower the min-compile-
+    time threshold so the small per-run programs of a scanned model are
+    cached too. Returns the resolved path. Call before the first jit
+    compilation; safe to call again (last dir wins)."""
+    path = os.path.expanduser(cache_dir or DEFAULT_CACHE_DIR)
+    os.makedirs(path, exist_ok=True)
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", path)
+    try:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except AttributeError:  # jax without the knob: default threshold applies
+        pass
+    return path

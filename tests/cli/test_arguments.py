@@ -73,3 +73,59 @@ def test_unknown_family_raises():
     args = initialize_galvatron(mode="train_dist", argv=["--model_type", "nope"])
     with pytest.raises(KeyError):
         model_config_from_args(args)
+
+
+def test_compilation_flags_default_and_plumbing(tmp_path):
+    """--no_scan_layers / --remat_policy reach HybridParallelConfig on both
+    the GLOBAL-flags path and the searched-JSON path (they are runtime
+    execution knobs, never part of the on-disk strategy schema)."""
+    args = initialize_galvatron(mode="train_dist", argv=[])
+    assert args.scan_layers is True and args.remat_policy == "full"
+    assert args.compile_cache == 0
+    hp = hp_config_from_args(args, num_layers=2, world_size=8)
+    assert hp.scan_layers is True and hp.remat_policy == "full"
+
+    args = initialize_galvatron(mode="train_dist", argv=[
+        "--no_scan_layers", "--remat_policy", "dots_saveable",
+    ])
+    hp = hp_config_from_args(args, num_layers=2, world_size=8)
+    assert hp.scan_layers is False and hp.remat_policy == "dots_saveable"
+
+    from galvatron_tpu.config.strategy import HybridParallelConfig
+
+    ref = HybridParallelConfig.uniform(world_size=8, num_layers=2, tp=2, global_bsz=8)
+    p = tmp_path / "strategy.json"
+    ref.save(str(p))
+    assert "scan_layers" not in ref.to_json_dict()
+    args = initialize_galvatron(mode="train_dist", argv=[
+        "--galvatron_config_path", str(p), "--no_scan_layers",
+        "--remat_policy", "nothing_saveable", "--global_train_batch_size", "8",
+    ])
+    hp = hp_config_from_args(args, num_layers=2, world_size=8)
+    assert hp.scan_layers is False and hp.remat_policy == "nothing_saveable"
+    hp.assert_equal(ref)  # execution knobs don't change strategy identity
+
+
+def test_persistent_compile_cache_opt_in(tmp_path):
+    """enable_persistent_cache points jax at the requested dir (created if
+    missing). EVERY touched config knob is restored afterwards: leaking the
+    0.0 min-compile-time threshold into the session made later suite
+    compiles round-trip through the persistent cache, which 0.4.37's
+    XLA:CPU executable deserialization answers with a segfault mid-suite
+    (the same hazard class tests/conftest.py documents — it pins the
+    threshold at 1.0s for a reason)."""
+    import jax
+
+    from galvatron_tpu.utils.compile_cache import enable_persistent_cache
+
+    old_dir = jax.config.jax_compilation_cache_dir
+    old_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    try:
+        target = tmp_path / "xla_cache"
+        got = enable_persistent_cache(str(target))
+        assert got == str(target)
+        assert target.is_dir()
+        assert jax.config.jax_compilation_cache_dir == str(target)
+    finally:
+        jax.config.update("jax_compilation_cache_dir", old_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", old_min)

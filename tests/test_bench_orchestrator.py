@@ -51,6 +51,9 @@ def test_full_smoke_bench_on_cpu():
     env = {"JAX_PLATFORMS": "cpu", "GALVATRON_BENCH_DEADLINE": "500"}
     out = run_bench(env, timeout=560)
     assert out["value"] is not None and out["value"] > 0
+    # compile cost and steady-state step time are separate fields (ISSUE 3)
+    assert out["extra"]["compile_ms"] > 0 and out["extra"]["step_ms"] > 0
     ts = out["extra"]["train_step"]
     assert ts["step_ms"] > 0 and ts["tokens_per_sec_per_chip"] > 0
+    assert ts["compile_ms"] > 0
     assert out["extra"]["masked_flash"]["masked_vs_unmasked"] > 0
