@@ -25,6 +25,17 @@ A pure-AST pass (no execution of the linted code) over Python sources:
   rebinding — the buffer backing it may already be aliased to the output
   (the PR-1 anomaly-guard lesson: donated step inputs cannot be "kept" on
   the host side).
+- **GLC005 — blocking host sync in a loop**: driver-side loops that force a
+  host<->device round trip every iteration (``float(...)``/``.item()``/
+  ``np.asarray(...)`` on values produced by a jitted callable, or any
+  ``block_until_ready``) kill JAX's async dispatch: the device idles while
+  the host books keep, exactly the serialization the dispatch-ahead train
+  loop removes (cli/train.py ISSUE 4). Dispatch all iterations first and
+  drain once — or mark a deliberate sync point (profilers measure by
+  syncing) with the pragma. The value-producer taint is tracked through
+  names assigned from ``jax.jit(...)``-wrapped callables and
+  ``jax.device_put``, so plain host-numpy ``float()`` loops don't trip it;
+  ``block_until_ready`` is a sync by definition and is flagged untainted.
 
 Jit contexts are found both as decorators (``@jax.jit``,
 ``@partial(jax.jit, ...)``) and as wrappings of a locally-defined function
@@ -207,6 +218,8 @@ class _ModuleLint:
         self.jit_wrapped: Dict[str, _JitInfo] = {}
         # donated-jit callable name -> donated positions
         self.donated_callables: Dict[str, Tuple[int, ...]] = {}
+        # names bound to a jax.jit(...) result (device-value producers)
+        self.jit_callables: Set[str] = set()
 
     # ---- pass 1: imports, jit registry --------------------------------
     def scan_module(self):
@@ -224,10 +237,12 @@ class _ModuleLint:
                         self.jit_wrapped[fname] = ji
             elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
                 info = _jit_call_info(node.value, self.aliases)
-                if info is not None and info[1].donated:
+                if info is not None:
                     for t in node.targets:
                         if isinstance(t, ast.Name):
-                            self.donated_callables[t.id] = info[1].donated
+                            self.jit_callables.add(t.id)
+                            if info[1].donated:
+                                self.donated_callables[t.id] = info[1].donated
 
     # ---- GLC001 --------------------------------------------------------
     def _check_chain(self, chain: Sequence[str], lineno: int):
@@ -435,6 +450,93 @@ class _ModuleLint:
                 ))
                 break  # one finding per (name, call)
 
+    # ---- GLC005 --------------------------------------------------------
+    def _device_tainted_names(self) -> Set[str]:
+        """Names assigned (incl. tuple-unpacked) from a call to a known
+        jit-wrapped callable or from jax.device_put — conservative taint for
+        'this is (a tree of) device array(s)'."""
+        producers = set(self.jit_callables) | set(self.jit_wrapped)
+        tainted: Set[str] = set()
+        for node in ast.walk(self.tree):
+            if not (isinstance(node, ast.Assign) and isinstance(node.value, ast.Call)):
+                continue
+            fn = node.value.func
+            is_device = isinstance(fn, ast.Name) and fn.id in producers
+            if not is_device:
+                chain = _attr_chain(fn)
+                is_device = bool(
+                    chain and chain[0] in self.aliases.jax
+                    and chain[-1] in ("device_put", "device_put_sharded",
+                                      "device_put_replicated")
+                )
+            if is_device:
+                for t in node.targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            tainted.add(n.id)
+        return tainted
+
+    def _device_expr(self, expr: ast.AST, tainted: Set[str]) -> bool:
+        """expr references a tainted name or calls a jit callable."""
+        producers = set(self.jit_callables) | set(self.jit_wrapped)
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Name) and n.id in tainted:
+                return True
+            if (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+                    and n.func.id in producers):
+                return True
+        return False
+
+    def _blocking_sync(self, call: ast.Call, tainted: Set[str]) -> Optional[str]:
+        """The offending sync's key when `call` is a per-iteration blocking
+        host sync, else None."""
+        func = call.func
+        if isinstance(func, ast.Attribute) and func.attr == "block_until_ready":
+            chain = _attr_chain(func)
+            return ".".join(chain) if chain else "block_until_ready"
+        if (isinstance(func, ast.Attribute) and func.attr == "item"
+                and not call.args and self._device_expr(func.value, tainted)):
+            return "item"
+        if (isinstance(func, ast.Name) and func.id == "float"
+                and len(call.args) == 1
+                and self._device_expr(call.args[0], tainted)):
+            return "float"
+        chain = _attr_chain(func)
+        if (chain and chain[0] in self.aliases.numpy
+                and chain[-1] in ("asarray", "array") and call.args
+                and self._device_expr(call.args[0], tainted)):
+            return ".".join(chain)
+        return None
+
+    def check_host_syncs_in_loops(self):
+        if "GLC005" not in self.rules:
+            return
+        # loops inside jitted functions are traced, not executed per-step:
+        # a float() there is a different bug (GLC002/tracer error), not a sync
+        jit_nodes: Set[int] = set()
+        for fn, _ in self._jit_functions():
+            jit_nodes.update(id(n) for n in ast.walk(fn))
+        tainted = self._device_tainted_names()
+        seen: Set[Tuple[int, str]] = set()
+        for loop in ast.walk(self.tree):
+            if not isinstance(loop, (ast.For, ast.While)) or id(loop) in jit_nodes:
+                continue
+            for node in ast.walk(loop):
+                if not isinstance(node, ast.Call):
+                    continue
+                key = self._blocking_sync(node, tainted)
+                if key is None or (node.lineno, key) in seen:
+                    continue
+                seen.add((node.lineno, key))
+                self.diags.append(D.make(
+                    "GLC005", "blocking host sync %r inside a loop: every "
+                    "iteration stalls the host on the device (and the device "
+                    "on the host), killing async dispatch; dispatch all "
+                    "iterations first and drain once, or mark a deliberate "
+                    "sync point with the pragma" % key,
+                    file=self.filename, line=node.lineno, key=key,
+                ))
+
     # ---- pragmas -------------------------------------------------------
     def apply_pragmas(self) -> List[D.Diagnostic]:
         out = []
@@ -447,7 +549,7 @@ class _ModuleLint:
         return out
 
 
-ALL_RULES = frozenset({"GLC001", "GLC002", "GLC003", "GLC004"})
+ALL_RULES = frozenset({"GLC001", "GLC002", "GLC003", "GLC004", "GLC005"})
 
 
 def lint_source(
@@ -467,6 +569,7 @@ def lint_source(
     ml.check_attribute_chains()
     ml.check_jit_bodies()
     ml.check_donated_reuse()
+    ml.check_host_syncs_in_loops()
     return ml.apply_pragmas()
 
 

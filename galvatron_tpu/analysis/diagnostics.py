@@ -51,6 +51,7 @@ CODES: Dict[str, Tuple[str, str]] = {
     "GLC002": (WARNING, "host-side numpy call inside a jitted function"),
     "GLC003": (WARNING, "Python control flow on a traced value inside jit"),
     "GLC004": (ERROR, "donated buffer used again after the donating jit call"),
+    "GLC005": (WARNING, "blocking host sync inside a loop in driver code"),
 }
 
 

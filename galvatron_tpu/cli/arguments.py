@@ -104,6 +104,29 @@ def _add_train_args(p: argparse.ArgumentParser):
     g.add_argument("--eval_iters", type=int, default=5,
                    help="batches averaged per eval pass (and for the final "
                    "test-split eval)")
+    # dispatch-ahead input pipeline / deferred host sync (runtime/prefetch.py
+    # + the cli/train.py drain window): see README "Steady-state throughput"
+    g.add_argument("--no_async_loop", dest="async_loop", action="store_false",
+                   default=True,
+                   help="escape hatch: fully host-serialized training loop "
+                        "(no prefetch thread, metrics drained every step); "
+                        "losses are bit-identical either way")
+    g.add_argument("--prefetch_batches", type=int, default=2,
+                   help="batches the background prefetcher prepares and "
+                        "device_puts ahead of the step consuming them "
+                        "(0 => prepare batches on the critical path)")
+    g.add_argument("--donate_step", type=int, default=1,
+                   help="donate params/opt_state buffers to the jitted step "
+                        "(halves resident model state). XLA:CPU executes a "
+                        "call with donated in-flight inputs synchronously, "
+                        "so CPU host-overlap measurements set 0; TPU "
+                        "runtimes dispatch donated futures asynchronously")
+    g.add_argument("--inflight_steps", type=int, default=2,
+                   help="dispatched steps whose metrics may stay undrained, "
+                        "so the host dispatches ahead of the device; anomaly "
+                        "detection and iteration logs lag by at most this "
+                        "many steps (forced drain at eval/save/preemption "
+                        "boundaries; 0 => drain every step)")
     g.add_argument("--profile", type=int, default=0, help="enable the runtime profiler")
     g.add_argument("--train_log_dir", type=str, default=None,
                    help="tee rank-0 iteration stats to <dir>/train_<model>.log")

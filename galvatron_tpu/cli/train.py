@@ -7,12 +7,15 @@ registry; the per-layer strategy comes from GLOBAL flags or a searched JSON
 
 from __future__ import annotations
 
+import hashlib
 import os
 import sys
 import time
+from collections import OrderedDict, deque
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from galvatron_tpu.cli.arguments import (
@@ -26,6 +29,47 @@ from galvatron_tpu.runtime import resilience as rsl
 from galvatron_tpu.runtime.dataloader import get_train_iterator
 from galvatron_tpu.runtime.model_api import construct_hybrid_parallel_model
 from galvatron_tpu.runtime.optimizer import OptimizerArgs, get_optimizer_and_scheduler
+from galvatron_tpu.runtime.prefetch import PrefetchIterator
+
+
+# In-process memo of AOT-compiled train-step executables, keyed by (device
+# ids, sha256 of the lowered StableHLO). Repeated train() calls in one
+# interpreter (search trials, resume-after-rollback rebuilds, test suites)
+# re-trace cheaply and then REUSE the executable instead of re-running XLA.
+# This is deliberately NOT the persistent compilation cache: on jaxlib
+# 0.4.37, deserializing an XLA:CPU executable corrupts the allocator heap
+# (see tests/conftest.py — two reverts' worth of history), while same-
+# process reuse of the live executable object involves no serialization at
+# all. The HLO text embeds input/output shardings and donation aliasing, so
+# an exact-text hit on the same devices is semantically the same program.
+_STEP_EXECUTABLES: "OrderedDict" = OrderedDict()
+_STEP_EXECUTABLES_MAX = 16
+
+
+def _step_exec_key(mesh, lowered):
+    try:
+        text = lowered.as_text()
+        devs = tuple(int(d.id) for d in mesh.devices.flat)
+    except Exception:
+        return None
+    return (devs, hashlib.sha256(text.encode()).hexdigest())
+
+
+def _compile_uncached(lowered):
+    """Compile with the persistent compilation cache bypassed. On jaxlib
+    0.4.37 a deserialized XLA:CPU executable coming back through the cache
+    corrupts the allocator heap when executed via the AOT fast path
+    (deterministic SIGSEGV/abort on the third train() of a process — see
+    tests/conftest.py history). In-process reuse goes through
+    _STEP_EXECUTABLES instead, which never serializes."""
+    prev = jax.config.jax_compilation_cache_dir
+    if prev is None:
+        return lowered.compile()
+    try:
+        jax.config.update("jax_compilation_cache_dir", None)
+        return lowered.compile()
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
 
 
 def optimizer_args_from(args) -> OptimizerArgs:
@@ -181,7 +225,10 @@ def train(args) -> dict:
         if jax.process_index() == 0:
             print("resumed from %s at iteration %d" % (args.load, start_iter))
 
-    step_fn = model.make_train_step(tx, guard_anomalies=guard is not None)
+    step_fn = model.make_train_step(
+        tx, guard_anomalies=guard is not None,
+        donate=bool(getattr(args, "donate_step", 1)),
+    )
     if hooks is not None and hooks.wrap_step_fn:
         step_fn = hooks.wrap_step_fn(step_fn)
 
@@ -200,8 +247,19 @@ def train(args) -> dict:
                 t0 = time.perf_counter()
                 lowered = step_fn.lower(*step_args)
                 t1 = time.perf_counter()
-                compiled = lowered.compile()
+                key = _step_exec_key(model.mesh, lowered)
+                compiled = _STEP_EXECUTABLES.get(key) if key is not None else None
+                if compiled is None:
+                    compiled = _compile_uncached(lowered)
+                    if key is not None:
+                        _STEP_EXECUTABLES[key] = compiled
+                        while len(_STEP_EXECUTABLES) > _STEP_EXECUTABLES_MAX:
+                            _STEP_EXECUTABLES.popitem(last=False)
+                else:
+                    _STEP_EXECUTABLES.move_to_end(key)
                 t2 = time.perf_counter()
+                # an executable-memo hit reports compile_ms ~0 — true: this
+                # process did not run XLA again for this program
                 prof.record_compile(trace_ms=(t1 - t0) * 1e3,
                                     compile_ms=(t2 - t1) * 1e3)
                 _aot["fn"] = compiled
@@ -233,7 +291,60 @@ def train(args) -> dict:
             it_ = hooks.wrap_data_iter(it_, start_step)
         return it_
 
-    data_iter = make_stream(start_iter)
+    # --------------------------------------------------- dispatch-ahead knobs
+    # --no_async_loop is the escape hatch back to the fully host-serialized
+    # loop: no prefetch thread, no deferred metrics (every step drains
+    # immediately). With the async loop (default), a background thread runs
+    # batch prep + the sharded device_put for the next `prefetch_batches`
+    # batches, and the host keeps up to `inflight_steps` dispatched steps'
+    # metrics undrained so it can issue step N+1..N+W while N executes.
+    async_loop = bool(getattr(args, "async_loop", 1))
+    prefetch_depth = max(int(getattr(args, "prefetch_batches", 2) or 0), 0)
+    inflight_window = max(int(getattr(args, "inflight_steps", 2) or 0), 0)
+    if not async_loop:
+        prefetch_depth = 0
+        inflight_window = 0
+
+    def _retrying(it_):
+        """Per-batch retry (transient dataloader I/O) as an iterator, so the
+        prefetch worker keeps the same backoff the sync path has."""
+        while True:
+            try:
+                b = rsl.with_retry(lambda: next(it_), retry_policy, res,
+                                   description="dataloader")
+            except StopIteration:
+                return
+            yield b
+
+    stream = {"prefetch": None, "iter": None}
+
+    def close_stream():
+        if stream["prefetch"] is not None:
+            stream["prefetch"].close()
+        stream["prefetch"] = None
+        stream["iter"] = None
+
+    def open_stream(start_step: int):
+        """(Re)build the input pipeline at `start_step` — also the rollback
+        path, which must discard the old prefetch thread's buffered batches
+        along with the abandoned trajectory."""
+        close_stream()
+        it_ = make_stream(start_step)
+        if prefetch_depth > 0:
+            stream["prefetch"] = PrefetchIterator(
+                _retrying(it_), depth=prefetch_depth, place_fn=model.shard_batch,
+            )
+        else:
+            stream["iter"] = it_
+
+    def next_batch():
+        if stream["prefetch"] is not None:
+            return next(stream["prefetch"])  # sharded by the prefetch worker
+        b = rsl.with_retry(lambda: next(stream["iter"]), retry_policy, res,
+                           description="dataloader")
+        return model.shard_batch(b)
+
+    open_stream(start_iter)
 
     eval_interval = getattr(args, "eval_interval", 0) or 0
     eval_iters = max(getattr(args, "eval_iters", 5) or 0, 1)
@@ -256,12 +367,11 @@ def train(args) -> dict:
 
     def evaluate(params, split):
         """Mean loss over the split's cached batches (reference
-        train_dist.py's evaluate-and-log pass; dataloader.py:4-20 builds the
-        valid/test splits it consumes)."""
-        total = 0.0
-        for b in eval_batches[split]:
-            total += float(eval_fn(params, b))
-        return total / eval_iters
+        train_dist.py's evaluate-and-log pass). All eval batches are
+        dispatched back-to-back and drained ONCE — the old per-batch
+        ``float()`` re-serialized host and device for the whole pass."""
+        vals = [eval_fn(params, b) for b in eval_batches[split]]
+        return float(jnp.sum(jnp.stack(vals))) / eval_iters
     prof = RuntimeProfiler(
         warmup=min(2, max(args.train_iters - 1, 0)),
         rank=jax.process_index(),
@@ -289,86 +399,127 @@ def train(args) -> dict:
     losses = []
     loss_iters = []  # iteration of each accepted loss (rollback truncation)
     valid_losses = []  # (iteration, mean valid loss)
+    inflight = deque()  # (iteration, metrics) dispatched but not yet drained
     interrupted = None
     last_save = None
     it = start_iter
+
+    def drain_one():
+        """Drain the oldest in-flight step: block on its metrics and run the
+        host-side bookkeeping the synchronous loop did inline (iteration
+        log, anomaly accounting). Returns (iteration, rollback_needed)."""
+        d_it, metrics = inflight.popleft()
+        prof.end(d_it, n_samples=hp.global_bsz, outputs=metrics["loss"])
+        if args.profile or d_it % max(args.log_interval, 1) == 0:
+            prof.log_iteration(d_it, metrics)
+        loss = float(metrics["loss"])
+        verdict = guard.observe(loss) if guard is not None else "ok"
+        if verdict == "ok":
+            losses.append(loss)
+            loss_iters.append(d_it)
+            return d_it, False
+        # the jitted step already kept the old params/opt_state
+        # (guard_anomalies select); only account and maybe roll back
+        res.anomalies_skipped += 1
+        if jax.process_index() == 0:
+            print(
+                "iteration %d: %s anomaly (loss %r) — update skipped "
+                "(strike %d/%d)"
+                % (d_it, verdict, loss, guard.strikes, guard.cfg.max_strikes)
+            )
+        return d_it, guard.should_roll_back
+
+    def drain_inflight(window: int) -> bool:
+        """Drain until at most `window` steps remain in flight (window=0 is
+        the forced drain at eval/save/preemption boundaries and in the
+        synchronous escape-hatch loop). On a guard-demanded rollback the
+        rest of the window is discarded undrained — those steps extend the
+        abandoned trajectory — and the checkpoint/stream state is swapped
+        here. Returns True iff a rollback happened, so the caller re-enters
+        the loop at the restored iteration."""
+        nonlocal it, params, opt_state
+        while len(inflight) > window:
+            d_it, need_rollback = drain_one()
+            if not need_rollback:
+                continue
+            intact = ckpt.intact_iterations(args.save) if args.save else []
+            if res.rollbacks >= guard.cfg.max_rollbacks or not intact:
+                raise rsl.TrainingAnomalyError(
+                    "persistent training anomalies at iteration %d "
+                    "(%d consecutive; %d rollbacks used, %s checkpoints "
+                    "to roll back to)"
+                    % (d_it, guard.strikes, res.rollbacks,
+                       len(intact) if args.save else "no")
+                )
+            res.rollbacks += 1
+            inflight.clear()  # the not-yet-drained steps are abandoned too
+            prev_opt_state = opt_state
+            params, opt_state, meta = load_from(args.save, None)
+            if opt_state is None:  # params-only checkpoint
+                opt_state = prev_opt_state
+            it = int(meta.get("iteration", 0))
+            res.torn_checkpoints_skipped += len(meta.get("torn_iterations", ()))
+            while loss_iters and loss_iters[-1] >= it:
+                loss_iters.pop()
+                losses.pop()
+            while valid_losses and valid_losses[-1][0] > it:
+                valid_losses.pop()
+            # optional stream reseed: shift the deterministic stream
+            # so the replay does not hit the same poisoned batch
+            offset = res.rollbacks * getattr(args, "anomaly_reseed", 0)
+            open_stream(it + offset)
+            guard.reset_after_rollback()
+            if jax.process_index() == 0:
+                print(
+                    "rolled back to checkpoint iteration %d "
+                    "(rollback %d/%d, stream offset +%d)"
+                    % (it, res.rollbacks, guard.cfg.max_rollbacks, offset)
+                )
+            return True
+        return False
+
     try:
-        while it < args.train_iters:
-            if hooks is not None and hooks.on_step:
-                hooks.on_step(it)
-            if preempt is not None and preempt.triggered:
-                interrupted = preempt.signal_name
+        while True:
+            if interrupted is None and it < args.train_iters:
+                if hooks is not None and hooks.on_step:
+                    hooks.on_step(it)
+                if preempt is not None and preempt.triggered:
+                    interrupted = preempt.signal_name
+            if interrupted is not None or it >= args.train_iters:
+                # loop exit: forced full drain first. A rollback surfacing in
+                # the final drain resumes training at the restored iteration
+                # — unless we are exiting on a preemption signal, where the
+                # emergency save (of the rolled-back state) takes priority.
+                if drain_inflight(0) and interrupted is None:
+                    continue
                 break
-            batch = rsl.with_retry(lambda: next(data_iter), retry_policy, res,
-                                   description="dataloader")
-            batch = model.shard_batch(batch)
+            batch = next_batch()
             prof.start(it)
             if guard is not None:
+                # NB deferred metrics: the spike cap is computed from losses
+                # drained so far, i.e. it lags the dispatched step by at most
+                # `inflight_steps` (NaN/Inf gating is in-jit and exact)
                 params, opt_state, metrics = compiled_step(
                     params, opt_state, batch, np.float32(guard.spike_cap()))
             else:
                 params, opt_state, metrics = compiled_step(params, opt_state, batch)
-            prof.end(it, n_samples=hp.global_bsz, outputs=metrics["loss"])
-            if args.profile or it % max(args.log_interval, 1) == 0:
-                prof.log_iteration(it, metrics)
-            loss = float(metrics["loss"])
-            verdict = guard.observe(loss) if guard is not None else "ok"
-            if verdict == "ok":
-                losses.append(loss)
-                loss_iters.append(it)
-            else:
-                # the jitted step already kept the old params/opt_state
-                # (guard_anomalies select); only account and maybe roll back
-                res.anomalies_skipped += 1
-                if jax.process_index() == 0:
-                    print(
-                        "iteration %d: %s anomaly (loss %r) — update skipped "
-                        "(strike %d/%d)"
-                        % (it, verdict, loss, guard.strikes, guard.cfg.max_strikes)
-                    )
-                if guard.should_roll_back:
-                    intact = ckpt.intact_iterations(args.save) if args.save else []
-                    if res.rollbacks >= guard.cfg.max_rollbacks or not intact:
-                        raise rsl.TrainingAnomalyError(
-                            "persistent training anomalies at iteration %d "
-                            "(%d consecutive; %d rollbacks used, %s checkpoints "
-                            "to roll back to)"
-                            % (it, guard.strikes, res.rollbacks,
-                               len(intact) if args.save else "no")
-                        )
-                    res.rollbacks += 1
-                    prev_opt_state = opt_state
-                    params, opt_state, meta = load_from(args.save, None)
-                    if opt_state is None:  # params-only checkpoint
-                        opt_state = prev_opt_state
-                    it = int(meta.get("iteration", 0))
-                    res.torn_checkpoints_skipped += len(meta.get("torn_iterations", ()))
-                    while loss_iters and loss_iters[-1] >= it:
-                        loss_iters.pop()
-                        losses.pop()
-                    while valid_losses and valid_losses[-1][0] > it:
-                        valid_losses.pop()
-                    # optional stream reseed: shift the deterministic stream
-                    # so the replay does not hit the same poisoned batch
-                    offset = res.rollbacks * getattr(args, "anomaly_reseed", 0)
-                    data_iter = make_stream(it + offset)
-                    guard.reset_after_rollback()
-                    if jax.process_index() == 0:
-                        print(
-                            "rolled back to checkpoint iteration %d "
-                            "(rollback %d/%d, stream offset +%d)"
-                            % (it, res.rollbacks, guard.cfg.max_rollbacks, offset)
-                        )
-                    continue
-            if eval_interval and (it + 1) % eval_interval == 0:
-                vloss = evaluate(params, "valid")
-                valid_losses.append((it + 1, vloss))
-                if jax.process_index() == 0:
-                    print("iteration %d: valid loss %.6f" % (it + 1, vloss))
-            if args.save and args.save_interval and (it + 1) % args.save_interval == 0:
-                save_now(it + 1)
-                last_save = it + 1
+            prof.dispatched(it)
+            inflight.append((it, metrics))
             it += 1
+            if drain_inflight(inflight_window):
+                continue
+            if eval_interval and it % eval_interval == 0:
+                if drain_inflight(0):  # forced drain before every eval
+                    continue
+                vloss = evaluate(params, "valid")
+                valid_losses.append((it, vloss))
+                if jax.process_index() == 0:
+                    print("iteration %d: valid loss %.6f" % (it, vloss))
+            if args.save and args.save_interval and it % args.save_interval == 0:
+                if drain_inflight(0):  # forced drain before every save
+                    continue
+                save_now(it)
+                last_save = it
         if interrupted is not None and args.save and last_save != it:
             # preemption: commit the state reached so far at the step boundary
             save_now(it, emergency=True)
@@ -379,7 +530,11 @@ def train(args) -> dict:
         elif args.save and last_save != it:
             save_now(it)
             last_save = it
+        # end-of-run fence: steady-state numbers must not credit device work
+        # still in flight behind the last dispatch
+        prof.loop_fence((params, opt_state))
     finally:
+        close_stream()
         if preempt is not None:
             preempt.uninstall()
     prof.resilience_counters = res.as_dict()

@@ -72,11 +72,11 @@ def _aggregate(ts: Sequence[float], mode: str) -> float:
 def _time_fn(fn: Callable, args: tuple, warmup: int, iters: int, mode: str) -> float:
     """Wall-time one jitted program (seconds)."""
     for _ in range(warmup):
-        jax.block_until_ready(fn(*args))
+        jax.block_until_ready(fn(*args))  # galv-lint: ignore[GLC005] -- profilers measure BY syncing
     ts = []
     for _ in range(iters):
         t0 = time.perf_counter()
-        jax.block_until_ready(fn(*args))
+        jax.block_until_ready(fn(*args))  # galv-lint: ignore[GLC005] -- profilers measure BY syncing
         ts.append(time.perf_counter() - t0)
     return _aggregate(ts, mode)
 

@@ -84,11 +84,11 @@ def _tree_bytes(tree) -> int:
 
 def _walltime(fn, args, warmup, iters) -> float:
     for _ in range(warmup):
-        jax.block_until_ready(fn(*args))
+        jax.block_until_ready(fn(*args))  # galv-lint: ignore[GLC005] -- profilers measure BY syncing
     ts = []
     for _ in range(iters):
         t0 = time.perf_counter()
-        jax.block_until_ready(fn(*args))
+        jax.block_until_ready(fn(*args))  # galv-lint: ignore[GLC005] -- profilers measure BY syncing
         ts.append(time.perf_counter() - t0)
     return float(np.mean(ts))
 

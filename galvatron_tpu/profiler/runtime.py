@@ -74,9 +74,20 @@ class RuntimeProfiler:
     # <log_dir>/train_<model_name>.log (the search engine's per-task log
     # discipline applied to training; reference logs rank-0 prints only)
     _t0: float = 0.0
+    # per-iteration start stamps keyed by iteration: the dispatch-ahead loop
+    # keeps a window of steps in flight, so start(N+2) can precede end(N)
+    _t0s: Dict[int, float] = field(default_factory=dict)
+    _wall_t0: Optional[float] = None  # first post-warmup start (loop_fence)
+    _started: int = 0  # post-warmup dispatches (rollback replays count)
     iter_times_ms: List[float] = field(default_factory=list)
     all_times_ms: List[float] = field(default_factory=list)
     samples: List[int] = field(default_factory=list)
+    dispatch_ms: List[float] = field(default_factory=list)  # start -> step
+    # call returned (host enqueue cost; the device may still be running)
+    host_blocked_ms: List[float] = field(default_factory=list)  # time the
+    # host spent blocked on the device inside end()'s block_until_ready —
+    # the number the dispatch-ahead loop exists to drive to ~zero
+    loop_wall_ms: Optional[float] = None  # fence-to-fence post-warmup wall
     memory_snapshots: Dict[str, Dict[str, float]] = field(default_factory=dict)
     resilience_counters: Optional[Dict[str, int]] = None  # set by the train
     # driver (runtime/resilience.py ResilienceCounters.as_dict()): anomalies
@@ -89,18 +100,49 @@ class RuntimeProfiler:
     def start(self, iteration: int):
         self._iter = iteration
         self._t0 = time.perf_counter()
+        self._t0s[iteration] = self._t0
+        if iteration >= self.warmup:
+            if self._wall_t0 is None:
+                self._wall_t0 = self._t0
+            self._started += 1
+
+    def dispatched(self, iteration: int):
+        """Call right after the (async) step call returns: records the host
+        dispatch cost of this iteration — how long the host held the critical
+        path before handing the program to the device."""
+        t0 = self._t0s.get(iteration, self._t0)
+        dt = (time.perf_counter() - t0) * 1e3
+        if iteration >= self.warmup:
+            self.dispatch_ms.append(dt)
+        return dt
 
     def end(self, iteration: int, n_samples: int = 0, outputs=None):
         """Call with the step outputs so the timer blocks until the device
-        finishes (outputs=None times dispatch only)."""
+        finishes (outputs=None times dispatch only). Under the dispatch-ahead
+        loop this runs at drain time, possibly several iterations after
+        start(); the blocked interval inside block_until_ready is recorded
+        separately as host_blocked_ms."""
+        tb = time.perf_counter()
         if outputs is not None:
             jax.block_until_ready(outputs)
-        dt = (time.perf_counter() - self._t0) * 1e3
+        now = time.perf_counter()
+        dt = (now - self._t0s.pop(iteration, self._t0)) * 1e3
         self.all_times_ms.append(dt)
         if iteration >= self.warmup:
             self.iter_times_ms.append(dt)
             self.samples.append(n_samples)
+            self.host_blocked_ms.append((now - tb) * 1e3)
         return dt
+
+    def loop_fence(self, outputs=None):
+        """End-of-run fence: block until the device has fully drained, then
+        record the post-warmup loop wall time. Without this fence the
+        dispatch-ahead loop's steady-state numbers would credit work the
+        device has not finished."""
+        if outputs is not None:
+            jax.block_until_ready(outputs)
+        if self._wall_t0 is not None and self._started > 0:
+            self.loop_wall_ms = (time.perf_counter() - self._wall_t0) * 1e3
 
     def record_compile(self, trace_ms: Optional[float] = None,
                        compile_ms: Optional[float] = None):
@@ -144,6 +186,19 @@ class RuntimeProfiler:
                 "peak_hbm_mb": peak / 2**20,
                 "iters": len(self.iter_times_ms),
             }
+        if self.dispatch_ms:
+            out["dispatch_ms"] = float(np.mean(self.dispatch_ms))
+        if self.host_blocked_ms:
+            out["host_blocked_ms"] = float(np.mean(self.host_blocked_ms))
+            out["host_blocked_ms_total"] = float(np.sum(self.host_blocked_ms))
+        if self.loop_wall_ms is not None and self._started > 0:
+            # the honest steady-state throughput: post-warmup dispatches over
+            # fenced wall time (iter_times_ms measures dispatch->drain
+            # latency, which overlaps across iterations under dispatch-ahead)
+            out["loop_wall_ms"] = self.loop_wall_ms
+            out["wall_ms_per_iter"] = self.loop_wall_ms / self._started
+            if self.loop_wall_ms > 0:
+                out["steps_per_s"] = self._started / (self.loop_wall_ms / 1e3)
         if self.trace_ms is not None:
             out["trace_ms"] = self.trace_ms
         if self.compile_ms is not None:

@@ -18,8 +18,8 @@ and of `GalvatronModel.forward_backward` (:42-70). Here the assembly is:
 
 from __future__ import annotations
 
-from dataclasses import dataclass
-from typing import Any, Callable, Dict, Optional
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -56,6 +56,11 @@ class HybridParallelModel:
     # loss for evaluation: under the 1f1b engines, loss_fn is the grad-bearing
     # schedule (loss and grads come out of one scan, so XLA cannot DCE the
     # backward); this is the cheap path (reference evaluation is forward-only)
+    # memoized NamedSharding trees per batch signature (key set + ranks), so
+    # the per-step shard_batch is ONE device_put of the whole tree with no
+    # per-key NamedSharding construction on the hot path
+    _batch_shardings: Dict[Tuple, Dict[str, NamedSharding]] = field(
+        default_factory=dict, repr=False)
 
     @property
     def eval_loss(self) -> Callable:
@@ -101,10 +106,23 @@ class HybridParallelModel:
         return {k: self._batch_spec_for(v) for k, v in batch_example.items()}
 
     def shard_batch(self, batch):
-        return {
-            k: jax.device_put(v, NamedSharding(self.mesh, self._batch_spec_for(v)))
+        """One sharded transfer for the whole batch: the sharding tree is
+        precomputed per batch signature and the entire dict goes through a
+        single ``jax.device_put`` — no per-key Python round trips, and the
+        runtime can overlap the per-leaf copies (the prefetch thread issues
+        this ahead of the step that consumes it)."""
+        sig = tuple(sorted(
+            (k, getattr(v, "ndim", None) or len(getattr(v, "shape", ())))
             for k, v in batch.items()
-        }
+        ))
+        shardings = self._batch_shardings.get(sig)
+        if shardings is None:
+            shardings = {
+                k: NamedSharding(self.mesh, self._batch_spec_for(v))
+                for k, v in batch.items()
+            }
+            self._batch_shardings[sig] = shardings
+        return jax.device_put(batch, shardings)
 
     # -------------------------------------------------------------- train step
     def zero_axes_tree(self):
@@ -146,14 +164,22 @@ class HybridParallelModel:
         )
 
     def make_train_step(self, tx: optax.GradientTransformation, *,
-                        guard_anomalies: bool = False):
+                        guard_anomalies: bool = False, donate: bool = True):
         """The jitted (params, opt_state, batch[, spike_cap]) -> (params,
         opt_state, metrics) step. With `guard_anomalies` the step takes a
         fourth `spike_cap` scalar and refuses to apply an update whose loss
         or grad norm is non-finite or whose loss exceeds the cap: params and
         opt_state pass through unchanged and metrics["anomalous"] is set.
         The select must live INSIDE the step — inputs are donated, so the
-        host cannot keep the old state around to retry with."""
+        host cannot keep the old state around to retry with.
+
+        `donate=False` keeps params/opt_state un-donated (two resident
+        copies of the model state). It exists for the dispatch-ahead loop on
+        XLA:CPU, whose runtime executes a call synchronously whenever a
+        donated input buffer is still being produced by the previous call —
+        donation there serializes host and device no matter how far ahead
+        the host dispatches. TPU runtimes handle donated futures
+        asynchronously, so production keeps the default."""
         hp, mesh = self.hp, self.mesh
         # pp>1: the scan pipeline consumes the whole batch as `chunks`
         # microbatches itself — no outer accumulation loop.
@@ -238,12 +264,13 @@ class HybridParallelModel:
                 metrics["anomalous"] = bad
             return new_params, new_opt_state, metrics
 
+        donate_argnums = (0, 1) if donate else ()
         if not guard_anomalies:
             def plain_step(params, opt_state, batch):
                 return train_step(params, opt_state, batch)
 
-            return jax.jit(plain_step, donate_argnums=(0, 1))
-        return jax.jit(train_step, donate_argnums=(0, 1))
+            return jax.jit(plain_step, donate_argnums=donate_argnums)
+        return jax.jit(train_step, donate_argnums=donate_argnums)
 
     def opt_state_shardings(self, tx: optax.GradientTransformation, params: Params):
         state_shape = jax.eval_shape(tx.init, params)

@@ -1,0 +1,154 @@
+"""Background-thread input prefetcher: dispatch-ahead data pipeline.
+
+The steady-state train loop is only as fast as its slowest serial segment.
+Before this module the loop was host-serialized: numpy batch prep (zigzag
+permutation, label rolling) ran on the critical path, then a blocking
+``device_put``, then the step — the device idled during data prep and the
+host idled during the step. :class:`PrefetchIterator` moves the host work
+off the critical path: a daemon thread pulls from the underlying iterator,
+applies ``place_fn`` (the driver passes ``model.shard_batch``, a single
+sharded ``jax.device_put`` of the whole batch tree), and parks up to
+``depth`` already-placed batches in a bounded queue so the transfer of batch
+N+1..N+depth overlaps the compute of batch N.
+
+Contract:
+
+- **Ordering**: batches come out in exactly the order the source yields
+  them (single worker, FIFO queue) — required for bitwise loss parity with
+  the synchronous loop and for step-indexed fault injection.
+- **Bounded**: at most ``depth`` placed batches are buffered (plus the one
+  the worker is currently preparing); a slow consumer back-pressures the
+  producer instead of ballooning host/device memory.
+- **Exceptions propagate**: an exception in the source iterator or in
+  ``place_fn`` is re-raised from :meth:`__next__` in the training thread —
+  a poisoned corpus or exhausted I/O retry budget fails the run, it does
+  not silently starve it.
+- **Clean shutdown**: :meth:`close` (also via context manager and the train
+  driver's ``finally``) unblocks and joins the worker, so preemption /
+  rollback / interpreter exit never leak a thread mid-``device_put``.
+
+jax note: issuing ``device_put`` from a non-main thread is supported; the
+backends must already be initialised (they are — the driver builds the mesh
+long before the first batch), and signal handlers stay on the main thread
+(:class:`~galvatron_tpu.runtime.resilience.PreemptionHandler` already
+guards against non-main installation).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterator, Optional
+
+__all__ = ["PrefetchIterator"]
+
+_ITEM, _DONE, _ERROR = "item", "done", "error"
+
+
+class PrefetchIterator:
+    """Wrap ``source`` so host batch prep + device placement run ahead of
+    the consumer on a background thread. Iterator protocol + context
+    manager; ``close()`` is idempotent."""
+
+    def __init__(
+        self,
+        source: Iterator,
+        depth: int = 2,
+        place_fn: Optional[Callable] = None,
+        name: str = "galvatron-prefetch",
+    ):
+        if depth < 1:
+            raise ValueError("prefetch depth must be >= 1, got %d" % depth)
+        self._source = source
+        self._place_fn = place_fn
+        self._queue: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._exhausted = False
+        self._error: Optional[BaseException] = None
+        self._closed = False
+        self._thread = threading.Thread(target=self._worker, name=name, daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------- producer
+    def _put(self, entry) -> bool:
+        """Blocking put that stays responsive to close(); False if closing."""
+        while not self._stop.is_set():
+            try:
+                self._queue.put(entry, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _worker(self):
+        try:
+            while not self._stop.is_set():
+                try:
+                    item = next(self._source)
+                except StopIteration:
+                    self._put((_DONE, None))
+                    return
+                if self._place_fn is not None:
+                    item = self._place_fn(item)
+                if not self._put((_ITEM, item)):
+                    return
+        except BaseException as e:  # noqa: BLE001 — relayed to the consumer
+            self._put((_ERROR, e))
+
+    # ------------------------------------------------------------- consumer
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._closed:
+            raise RuntimeError("PrefetchIterator used after close()")
+        if self._error is not None:
+            raise self._error
+        if self._exhausted:
+            raise StopIteration
+        while True:
+            try:
+                tag, payload = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                if not self._thread.is_alive() and self._queue.empty():
+                    # worker died without posting a marker (should not
+                    # happen; defensive against a killed interpreter)
+                    self._exhausted = True
+                    raise StopIteration
+                continue
+            if tag == _ITEM:
+                return payload
+            if tag == _DONE:
+                self._exhausted = True
+                raise StopIteration
+            self._error = payload
+            raise payload
+
+    # ------------------------------------------------------------- shutdown
+    def close(self, timeout: float = 5.0):
+        """Stop the worker and join it. Buffered batches are dropped (the
+        rollback path rebuilds the stream at a different step anyway)."""
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        # drain so a worker blocked in put() sees the stop event promptly
+        while True:
+            try:
+                self._queue.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc_info):
+        self.close()
+        return False
+
+    def __del__(self):  # pragma: no cover — best-effort
+        try:
+            self.close(timeout=0.1)
+        except Exception:
+            pass

@@ -1,0 +1,26 @@
+import jax
+import numpy as np
+
+
+def train_step(params, opt_state, batch):
+    return params, opt_state, {"loss": 0.0}
+
+
+step = jax.jit(train_step)
+eval_fn = jax.jit(lambda p, b: 0.0)
+
+
+def evaluate(params, batches):
+    total = 0.0
+    for b in batches:
+        total += float(eval_fn(params, b))  # GLC005: blocks every iteration
+    return total
+
+
+def loop(params, opt_state, batches):
+    for b in batches:
+        params, opt_state, metrics = step(params, opt_state, b)
+        jax.block_until_ready(metrics)  # GLC005: per-step device sync
+        print(np.asarray(metrics["loss"]))  # GLC005: host transfer in loop
+        print(metrics["grad_norm"].item())  # GLC005: scalar sync in loop
+    return params, opt_state

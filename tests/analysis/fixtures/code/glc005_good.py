@@ -1,0 +1,22 @@
+import jax
+
+
+def loss_fn(params, batch):
+    return 0.0
+
+
+eval_fn = jax.jit(loss_fn)
+
+
+def evaluate(params, batches):
+    # dispatch every batch back-to-back, then drain ONCE after the loop
+    vals = [eval_fn(params, b) for b in batches]
+    jax.block_until_ready(vals)
+    return sum(float(v) for v in vals)
+
+
+def host_side_loop(rows):
+    # host-numpy float() in a loop is fine: no device value involved
+    import numpy as np
+
+    return [float(np.sum(r)) for r in rows]

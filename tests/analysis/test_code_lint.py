@@ -16,7 +16,7 @@ def lint_fixture(name, **kw):
         return C.lint_source(fp.read(), filename=path, **kw)
 
 
-RULES = ("GLC001", "GLC002", "GLC003", "GLC004")
+RULES = ("GLC001", "GLC002", "GLC003", "GLC004", "GLC005")
 
 
 @pytest.mark.parametrize("code", RULES)
@@ -74,6 +74,50 @@ def test_compat_shim_names_resolve():
     r = C.JaxResolver()
     assert r.missing_prefix(("jax", "shard_map")) is None
     assert r.missing_prefix(("jax", "sharding", "get_abstract_mesh")) is None
+
+
+def test_glc005_flags_every_sync_kind():
+    ds = lint_fixture("glc005_bad.py")
+    assert sorted(d.key for d in ds) == [
+        "float", "item", "jax.block_until_ready", "np.asarray",
+    ], [d.format() for d in ds]
+
+
+def test_glc005_host_numpy_loop_not_flagged():
+    """Taint precision: float()/np.asarray in loops over plain host values
+    must not trip the rule — only values produced by jitted callables or
+    device_put (block_until_ready is a device sync by definition)."""
+    src = (
+        "import numpy as np\n"
+        "def f(xs):\n"
+        "    out = []\n"
+        "    for x in xs:\n"
+        "        out.append(float(np.sum(x)))\n"
+        "        out.append(np.asarray(x).mean())\n"
+        "    return out\n"
+    )
+    assert C.lint_source(src, "host.py") == []
+
+
+def test_glc005_exempts_loops_inside_jit():
+    """A Python loop inside a jitted function is unrolled at trace time —
+    per-iteration host syncs are a different failure mode (GLC002), not
+    GLC005."""
+    src = (
+        "import jax\n"
+        "other = jax.jit(lambda x: x)\n"
+        "@jax.jit\n"
+        "def f(xs):\n"
+        "    total = 0.0\n"
+        "    for i in range(4):\n"
+        "        total = total + float(other(xs))\n"
+        "    return total\n"
+    )
+    assert C.lint_source(src, "jit_loop.py", rules={"GLC005"}) == []
+    # the same loop OUTSIDE jit is flagged
+    src_host = src.replace("@jax.jit\n", "")
+    assert {d.code for d in C.lint_source(src_host, "host_loop.py",
+                                          rules={"GLC005"})} == {"GLC005"}
 
 
 def test_syntax_error_is_reported_not_raised():

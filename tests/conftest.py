@@ -24,12 +24,26 @@ jax.config.update("jax_platforms", "cpu")
 # measured 80s -> 43s on the heaviest pipeline-parity test, suite-wide ~2x.
 jax.config.update("jax_disable_most_optimizations", True)
 
-# Session-fresh persistent compile cache: identical train-step HLO recurs
-# across tests (same tiny configs under different drivers). A SHARED cache
-# dir was tried and reverted — XLA:CPU AOT entries embed host machine
+# Session-fresh persistent compile cache: identical HLO recurs across tests
+# (same tiny configs under different drivers) and compile time dominates
+# suite walltime — cache off, the suite runs ~3x over its budget. A SHARED
+# cache dir was tried and reverted — XLA:CPU AOT entries embed host machine
 # features, and reloading entries written by a process that detected a
 # different ISA risks SIGILL (cpu_aot_loader.cc). A tmpdir written and read
 # only by THIS process sidesteps that hazard; it is removed at exit.
+#
+# KNOWN HAZARD that scopes what may use this cache: on jaxlib 0.4.37,
+# executing a DESERIALIZED XLA:CPU executable through the AOT fast path
+# (`lower().compile()` then `Compiled.__call__` -> aot_cache_miss) corrupts
+# the allocator heap — deterministic SIGSEGV / "corrupted double-linked
+# list" abort on the third train() of one process, bisected cache-on=crash
+# cache-off=pass with both train-loop modes. cli/train.py therefore
+# compiles its AOT step with the cache BYPASSED (_compile_uncached) and
+# reuses executables through an in-process memo (_STEP_EXECUTABLES — live
+# objects, no serialization). Plain-jit round-trips through this cache have
+# held up across PR 2/3 suites; if an unexplained mid-suite SIGABRT
+# reappears (historically in test_resilience), suspect this cache first.
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 import atexit  # noqa: E402
 import shutil  # noqa: E402
 import tempfile  # noqa: E402
@@ -37,7 +51,6 @@ import tempfile  # noqa: E402
 _cache_dir = tempfile.mkdtemp(prefix="jaxcache_")
 atexit.register(shutil.rmtree, _cache_dir, ignore_errors=True)
 jax.config.update("jax_compilation_cache_dir", _cache_dir)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,128 @@
+"""PrefetchIterator contract tests: ordering, bounded buffering, exception
+propagation into the consumer thread, clean shutdown (the guarantees the
+dispatch-ahead train loop and its bitwise-parity claim rest on). Pure host
+tests — no jax device work."""
+
+import threading
+import time
+
+import pytest
+
+from galvatron_tpu.runtime.prefetch import PrefetchIterator
+
+
+def wait_until(pred, timeout=5.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if pred():
+            return True
+        time.sleep(0.005)
+    return False
+
+
+def test_yields_in_source_order_and_exhausts():
+    pf = PrefetchIterator(iter(range(10)), depth=3)
+    assert list(pf) == list(range(10))
+    with pytest.raises(StopIteration):
+        next(pf)
+
+
+def test_place_fn_applied_off_thread():
+    main = threading.get_ident()
+    placed_on = []
+
+    def place(x):
+        placed_on.append(threading.get_ident())
+        return x * 2
+
+    pf = PrefetchIterator(iter([1, 2, 3]), depth=2, place_fn=place)
+    assert list(pf) == [2, 4, 6]
+    assert placed_on and all(t != main for t in placed_on)
+
+
+def test_buffering_is_bounded():
+    pulled = []
+
+    def source():
+        for i in range(100):
+            pulled.append(i)
+            yield i
+
+    pf = PrefetchIterator(source(), depth=2)
+    # producer runs ahead only to depth + the one item in its hands
+    assert wait_until(lambda: len(pulled) >= 3)
+    time.sleep(0.1)
+    assert len(pulled) <= 4
+    assert next(pf) == 0
+    assert wait_until(lambda: len(pulled) >= 4)
+    time.sleep(0.1)
+    assert len(pulled) <= 5
+    pf.close()
+
+
+def test_source_exception_propagates_to_consumer():
+    def source():
+        yield 1
+        yield 2
+        raise OSError("corpus went away")
+
+    pf = PrefetchIterator(source(), depth=2)
+    assert next(pf) == 1
+    assert next(pf) == 2
+    with pytest.raises(OSError, match="corpus went away"):
+        next(pf)
+    # the failure is sticky, not swallowed into StopIteration
+    with pytest.raises(OSError):
+        next(pf)
+    pf.close()
+
+
+def test_place_fn_exception_propagates():
+    def bad_place(x):
+        raise ValueError("shard_batch blew up")
+
+    pf = PrefetchIterator(iter([1]), depth=1, place_fn=bad_place)
+    with pytest.raises(ValueError, match="shard_batch blew up"):
+        next(pf)
+    pf.close()
+
+
+def test_close_unblocks_and_joins_producer():
+    """close() must terminate a worker blocked on a full queue (the
+    preemption / rollback path) without consuming the infinite source."""
+
+    def infinite():
+        i = 0
+        while True:
+            yield i
+            i += 1
+
+    pf = PrefetchIterator(infinite(), depth=1)
+    assert next(pf) == 0
+    pf.close()
+    assert not pf._thread.is_alive()
+    with pytest.raises(RuntimeError):
+        next(pf)
+    pf.close()  # idempotent
+
+
+def test_context_manager_closes():
+    with PrefetchIterator(iter(range(5)), depth=2) as pf:
+        assert next(pf) == 0
+    assert not pf._thread.is_alive()
+
+
+def test_consumer_blocks_until_slow_producer_delivers():
+    def slow():
+        for i in range(3):
+            time.sleep(0.05)
+            yield i
+
+    pf = PrefetchIterator(slow(), depth=2)
+    assert [next(pf) for _ in range(3)] == [0, 1, 2]
+    pf.close()
+
+
+def test_depth_must_be_positive():
+    with pytest.raises(ValueError):
+        PrefetchIterator(iter([]), depth=0)
