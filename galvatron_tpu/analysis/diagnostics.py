@@ -46,6 +46,18 @@ CODES: Dict[str, Tuple[str, str]] = {
     "GLS101": (WARNING, "estimated per-device memory exceeds the HBM budget"),
     "GLS102": (WARNING, "expensive cross-layer redistribution between adjacent layers"),
     "GLS103": (WARNING, "suspicious but runnable configuration"),
+    # ---- elastic resume / checkpoint portability (GLS20x) ----
+    "GLS201": (ERROR, "model-config digest mismatch between checkpoint and run"),
+    "GLS202": (ERROR, "optimizer state incompatible with the checkpoint's"),
+    "GLS203": (ERROR, "no feasible strategy for the surviving mesh under the memory budget"),
+    "GLS204": (ERROR, "checkpoint lacks the provenance elastic resume requires"),
+    "GLS205": (ERROR, "world size changed but no replacement strategy was resolved"),
+    "GLS206": (ERROR, "cross-strategy relayout unsupported for this model family"),
+    # ---- checkpoint auditor (GLS21x) ----
+    "GLS210": (ERROR, "checkpoint step without a committed integrity manifest (torn save)"),
+    "GLS211": (WARNING, "stray or orphaned entry in the checkpoint directory"),
+    "GLS212": (ERROR, "malformed checkpoint manifest or inconsistent provenance"),
+    "GLS213": (WARNING, "checkpoint predates provenance (not elastically resumable)"),
     # ---- code linter (GLC0xx) ----
     "GLC001": (ERROR, "jax attribute chain missing from the installed jax"),
     "GLC002": (WARNING, "host-side numpy call inside a jitted function"),

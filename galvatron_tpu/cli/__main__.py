@@ -8,8 +8,9 @@ Subcommands replace the reference's per-model shell scripts
     profile            profile model computation/memory
     profile-hardware   profile ICI/DCN collective bandwidths
     lint               static analysis: validate strategy JSONs / scan code
-                       for jax-API drift and jit hazards (CPU only, no
-                       tracing; exits 1 on error diagnostics)
+                       for jax-API drift and jit hazards / audit checkpoint
+                       dirs offline (--ckpt: manifest integrity, provenance)
+                       (CPU only, no tracing; exits 1 on error diagnostics)
 """
 
 import sys

@@ -177,6 +177,26 @@ def _add_train_args(p: argparse.ArgumentParser):
     r.add_argument("--verify_checkpoint", type=int, default=1,
                    help="verify the integrity manifest on resume and fall "
                         "back to the latest intact checkpoint")
+    # elastic degraded-mesh resume (runtime/elastic.py): checkpoints carry a
+    # provenance block, so a run that lost devices can restore under a NEW
+    # strategy instead of failing the strategy assert
+    r.add_argument("--elastic", type=str, default="off",
+                   choices=("off", "resume", "search"),
+                   help="on --load with a changed device count: 'resume' "
+                        "restores under the --elastic_strategy JSON, "
+                        "'search' re-runs the strategy search for the "
+                        "surviving world size under the saved memory "
+                        "budget; 'off' keeps the strict same-strategy "
+                        "assert (refuses mesh changes)")
+    r.add_argument("--elastic_strategy", type=str, default=None,
+                   help="replacement strategy JSON for the surviving mesh "
+                        "(implies cross-strategy restore; used by both "
+                        "--elastic modes when given)")
+    r.add_argument("--elastic_memory_gb", type=float, default=None,
+                   help="HBM budget per chip for the elastic re-search "
+                        "(default: the budget recorded in the checkpoint's "
+                        "provenance, else %.0f GB); also recorded into new "
+                        "checkpoints' provenance" % 16.0)
 
 
 def _add_profile_args(p: argparse.ArgumentParser):

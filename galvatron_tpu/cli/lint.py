@@ -9,6 +9,10 @@ Usage:
     python -m galvatron_tpu.cli lint --code            # the installed package
     python -m galvatron_tpu.cli lint my_module.py some/dir
 
+    # audit a checkpoint directory offline (manifests, provenance, embedded
+    # strategy — no arrays restored):
+    python -m galvatron_tpu.cli lint --ckpt /ckpts/run42
+
 Exit-code contract: 0 = clean (warnings allowed), 1 = at least one error
 diagnostic, 2 = usage/IO failure. ``--json`` prints the machine-readable
 report (schema: analysis/diagnostics.py `DiagnosticReport.to_json`);
@@ -32,6 +36,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--code", action="store_true",
                    help="lint the installed galvatron_tpu package sources "
                         "(in addition to any explicit paths)")
+    p.add_argument("--ckpt", action="append", default=[], metavar="DIR",
+                   help="audit a checkpoint directory offline (repeatable): "
+                        "per-iteration manifest integrity, provenance "
+                        "presence/consistency, embedded-strategy lint "
+                        "(GLS21x; no arrays are restored)")
     p.add_argument("--json", dest="as_json", action="store_true",
                    help="machine-readable JSON output")
     p.add_argument("--strict", action="store_true",
@@ -76,9 +85,9 @@ def run(argv: Optional[List[str]] = None) -> int:
         import galvatron_tpu
 
         code_paths.append(os.path.dirname(galvatron_tpu.__file__))
-    if not json_paths and not code_paths:
-        print("nothing to lint: pass strategy .json / .py paths or --code",
-              file=sys.stderr)
+    if not json_paths and not code_paths and not args.ckpt:
+        print("nothing to lint: pass strategy .json / .py paths, --ckpt "
+              "dirs, or --code", file=sys.stderr)
         return 2
 
     report = D.DiagnosticReport()
@@ -113,6 +122,13 @@ def run(argv: Optional[List[str]] = None) -> int:
 
         rules = args.rules.split(",") if args.rules else None
         report.extend(C.lint_paths(code_paths, rules=rules).diagnostics)
+    for ckpt_dir in args.ckpt:
+        from galvatron_tpu.analysis import ckpt_lint as K
+
+        if not os.path.isdir(ckpt_dir):
+            print("cannot audit %s: not a directory" % ckpt_dir, file=sys.stderr)
+            return 2
+        report.extend(K.audit_checkpoint_dir(ckpt_dir).diagnostics)
 
     print(report.to_json() if args.as_json else report.render())
     if args.strict and report.warnings:

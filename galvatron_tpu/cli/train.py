@@ -155,7 +155,27 @@ def train(args) -> dict:
             print("persistent compilation cache: %s" % cache_path)
     fam, cfg = model_config_from_args(args)
     world = args.world_size or len(jax.devices())
-    hp = hp_config_from_args(args, cfg.num_layers, world)
+    # elastic degraded-mesh resume: when the device count no longer matches
+    # the checkpoint's provenance, re-plan the strategy for the surviving
+    # mesh (user-supplied JSON or a fresh search) instead of failing the
+    # strategy assert; on a matching mesh the SAVED strategy wins over the
+    # GLOBAL flags so a stale launch script cannot fork the trajectory
+    elastic_plan = None
+    if args.load and getattr(args, "elastic", "off") != "off":
+        from galvatron_tpu.runtime import elastic as els
+
+        elastic_plan = els.resolve_resume_strategy(
+            args, cfg, world, opt_args=optimizer_args_from(args))
+        hp = elastic_plan.hp
+        if jax.process_index() == 0 and elastic_plan.cross_strategy:
+            print(
+                "elastic resume (%s): checkpoint strategy (world %d) -> new "
+                "strategy (world %d)" % (
+                    elastic_plan.action, elastic_plan.saved_hp.world_size,
+                    hp.world_size)
+            )
+    else:
+        hp = hp_config_from_args(args, cfg.num_layers, world)
     # fail fast on a bad strategy BEFORE any tracing/compilation: the linter
     # re-checks engine consistency plus the model-aware divisibility rules
     # (heads/seq/vocab vs tp/cp/sp) that from_json alone cannot see
@@ -199,19 +219,28 @@ def train(args) -> dict:
     opt_state = model.init_opt_state(tx, params)
 
     def load_from(ckpt_dir, iteration):
-        return rsl.with_retry(
-            lambda: ckpt.load_checkpoint(
-                ckpt_dir,
-                iteration,
-                params_target=params,
-                params_shardings=model.shardings(),
-                opt_state_target=opt_state,
-                opt_state_shardings=model.opt_state_shardings(tx, params),
-                hp=hp,
-                verify_integrity=verify_ckpt,
-            ),
-            retry_policy, res, description="checkpoint restore",
+        # retries live INSIDE load_checkpoint now (around the manifest reads
+        # and the orbax restore), so structural refusals (GLS202) are never
+        # re-attempted while transient I/O still backs off
+        kwargs = dict(
+            params_target=params,
+            params_shardings=model.shardings(),
+            opt_state_target=opt_state,
+            opt_state_shardings=model.opt_state_shardings(tx, params),
+            hp=hp,
+            verify_integrity=verify_ckpt,
+            retry_policy=retry_policy,
+            counters=res,
         )
+        if elastic_plan is not None and elastic_plan.cross_strategy:
+            # strategy-portable restore into THIS model's shardings; the
+            # checkpoint's own strategy comes from its provenance
+            kwargs.update(
+                target=model, tx=tx, saved_strategy=elastic_plan.saved_hp,
+                hp=None, params_target=None, params_shardings=None,
+                opt_state_target=None, opt_state_shardings=None,
+            )
+        return ckpt.load_checkpoint(ckpt_dir, iteration, **kwargs)
 
     start_iter = 0
     if args.load:
@@ -383,6 +412,18 @@ def train(args) -> dict:
     if getattr(args, "emergency_save", 0):
         preempt = rsl.PreemptionHandler().install()
 
+    # every save — periodic, final, rollback re-save AND the emergency save a
+    # preemption triggers — carries provenance, so the NEXT resume can
+    # re-plan for whatever hardware survives
+    from galvatron_tpu.runtime import elastic as els
+
+    provenance = els.build_provenance(
+        hp, cfg, optimizer_args_from(args), mesh=model.mesh,
+        memory_budget_gb=getattr(args, "elastic_memory_gb", None) or (
+            elastic_plan.provenance.get("memory_budget_gb")
+            if elastic_plan is not None else None),
+    )
+
     def save_now(iteration: int, emergency: bool = False):
         meta = {"iteration": iteration}
         if emergency:
@@ -392,6 +433,7 @@ def train(args) -> dict:
             lambda: ckpt.save_checkpoint(
                 args.save, iteration, params, opt_state, hp, train_meta=meta,
                 keep_latest_k=getattr(args, "keep_latest_k", 0) or None,
+                provenance=provenance,
             ),
             retry_policy, res, description="checkpoint save",
         )
@@ -555,7 +597,21 @@ def train(args) -> dict:
 
 def main(argv=None):
     args = initialize_galvatron(mode="train_dist", argv=argv)
-    return train(args)
+    try:
+        return train(args)
+    except Exception as e:
+        from galvatron_tpu.analysis.diagnostics import DiagnosticError
+
+        if isinstance(e, DiagnosticError) and any(
+            d.code.startswith("GLS2") for d in e.diagnostics
+        ):
+            # the elastic-resume refusal contract: actionable diagnostics on
+            # stderr and exit code 2 (distinct from ordinary failures), so
+            # supervisors can tell "needs operator input" from "retry me"
+            for d in e.diagnostics:
+                print(d.format(), file=sys.stderr)
+            sys.exit(2)
+        raise
 
 
 if __name__ == "__main__":

@@ -230,12 +230,28 @@ def make_pipelined_loss(cfg, hp: HybridParallelConfig, mesh: Mesh):
         B = x.shape[0]
         mb = B // num_mb
 
-        def split(t):
-            return t.reshape((num_mb, mb) + t.shape[1:])
+        # jax 0.4.37 GSPMD hazard (sibling of the stack_layer_run finding in
+        # models/base.py): reshaping a dp-SHARDED batch dim into
+        # (num_mb, mb, ...) and feeding the result straight into the tick
+        # scan MISCOMPILES — silently wrong values, no error, and only when
+        # the incoming batch is sharded (an unsharded batch computes the
+        # pp=1 loss exactly; measured 4e-4 loss drift in float64, the
+        # test_pipeline_matches_dp failures). Pinning the microbatch layout
+        # explicitly (microbatch dim unsharded, per-microbatch batch dim on
+        # the dp axes) right after the reshape makes the result
+        # layout-independent again; tests pin this parity.
+        def split(t, seq_dim=2):
+            r = t.reshape((num_mb, mb) + t.shape[1:])
+            entries = [None, S._ax(vax.batch_axes)] + [None] * (r.ndim - 2)
+            if seq_dim is not None and r.ndim > seq_dim:
+                entries[seq_dim] = S._ax(vax.seq_axes)
+            return S.constrain(r, mesh, P(*entries))
 
         bias_mb = None
         if batch.get("attn_mask") is not None:
-            bias_mb = split(M.padding_attn_bias(batch["attn_mask"]))
+            # the bias' trailing dim is key positions, not the activation
+            # sequence layout — keep it (and the singleton dims) unsharded
+            bias_mb = split(M.padding_attn_bias(batch["attn_mask"]), seq_dim=None)
         # embed all microbatches up-front (replicated across pp groups; the
         # vocab layers' own parallelism comes from vocab_tp/vocab_sp axes)
         outs = pipeline_apply(params["stages"], split(x), split(positions), cfg, hp, mesh,

@@ -1,0 +1,408 @@
+"""Elastic degraded-mesh resume: re-plan the strategy for the surviving mesh.
+
+Galvatron's premise is that the optimal layer-wise strategy is a function of
+the hardware (PAPER.md) — so when the hardware changes mid-run (TPU
+preemption shrinking a slice, an ICI link flap dropping a host, a chip
+failure), the right response is not "refuse to resume" but "re-optimize for
+what survived". This module is the resume-side half of that story; the
+save-side half is the provenance block runtime/checkpoint.py embeds in every
+integrity manifest (:func:`build_provenance`).
+
+On resume with ``--elastic {resume,search}`` the driver calls
+:func:`resolve_resume_strategy`, which
+
+1. reads the newest intact manifest's provenance (strategy JSON, mesh/device
+   count, model-config digest, optimizer digest, chunks);
+2. refuses with structured GLS2xx diagnostics (exit code 2 at the CLI) when
+   the checkpoint cannot be resumed safely: different model-config digest
+   (GLS201), no provenance at all (GLS204), a changed mesh with no way to
+   pick a new strategy (GLS205), or no strategy that fits the memory budget
+   on the surviving devices (GLS203);
+3. on a world-size match returns the SAVED strategy — same-strategy resume
+   stays bitwise identical to the non-elastic path;
+4. on a mismatch either loads the user-supplied ``--elastic_strategy`` JSON
+   or re-runs :class:`GalvatronSearchEngine` for the surviving world size
+   under the same memory budget — with profiled cost tables when the config
+   dir has them, and an analytic Megatron-style fallback (the same tables
+   the strategy linter's GLS101 estimate uses) when it does not.
+
+The actual cross-strategy restore (different shardings, different pipeline
+layout, opt_state re-sharded leaf-wise with structural checks) is
+``load_checkpoint(..., target=)`` in runtime/checkpoint.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+from galvatron_tpu.analysis import diagnostics as D
+from galvatron_tpu.config.strategy import HybridParallelConfig
+
+DEFAULT_MEMORY_GB = 16.0  # matches the search CLI's --memory_constraint default
+
+# model-config fields excluded from the digest: precision knobs are runtime
+# choices (the manifest's spec_digest machinery already handles a dtype
+# change), not model identity
+_DIGEST_EXCLUDE = ("compute_dtype", "param_dtype", "attn_impl")
+
+
+def _stable_json(obj: Any) -> str:
+    return json.dumps(obj, sort_keys=True, default=str)
+
+
+def model_config_digest(model_cfg: Any) -> str:
+    """sha256 over the model's architectural identity. Restoring a checkpoint
+    into a model with a different digest is refused (GLS201): same-shaped
+    trees with different semantics (e.g. swapped activation) would restore
+    cleanly and train garbage."""
+    if dataclasses.is_dataclass(model_cfg):
+        fields = dataclasses.asdict(model_cfg)
+    else:  # duck-typed configs (tests)
+        fields = {k: v for k, v in vars(model_cfg).items() if not k.startswith("_")}
+    fields = {k: str(v) for k, v in fields.items() if k not in _DIGEST_EXCLUDE}
+    return hashlib.sha256(_stable_json(fields).encode()).hexdigest()
+
+
+def optimizer_digest(opt_args: Any) -> str:
+    """sha256 over the optimizer identity + hyperparams (runtime.optimizer
+    .OptimizerArgs). A mismatch on resume is a warning, not a refusal — lr
+    schedules legitimately change mid-run; the *structural* guard against a
+    different optimizer lives in load_checkpoint (GLS202)."""
+    fields = dataclasses.asdict(opt_args) if dataclasses.is_dataclass(opt_args) else dict(opt_args)
+    return hashlib.sha256(_stable_json({k: str(v) for k, v in fields.items()}).encode()).hexdigest()
+
+
+def build_provenance(
+    hp: HybridParallelConfig,
+    model_cfg: Any,
+    opt_args: Any = None,
+    mesh: Any = None,
+    memory_budget_gb: Optional[float] = None,
+) -> Dict[str, Any]:
+    """The manifest provenance block: everything a future process on
+    DIFFERENT hardware needs to decide how (or whether) to resume."""
+    prov: Dict[str, Any] = {
+        "format": 1,
+        "strategy": hp.to_json_dict(),
+        "world_size": hp.world_size,
+        "chunks": hp.chunks,
+        "global_bsz": hp.global_bsz,
+        "mixed_precision": hp.mixed_precision,
+        "model_digest": model_config_digest(model_cfg),
+    }
+    if mesh is not None:
+        prov["mesh_shape"] = {str(k): int(v) for k, v in dict(mesh.shape).items()}
+        prov["device_count"] = int(mesh.devices.size)
+    else:
+        prov["device_count"] = hp.world_size
+    if opt_args is not None:
+        prov["optimizer"] = {
+            "kind": type(opt_args).__name__,
+            "digest": optimizer_digest(opt_args),
+        }
+    if memory_budget_gb:
+        prov["memory_budget_gb"] = float(memory_budget_gb)
+    return prov
+
+
+# ------------------------------------------------------ analytic cost tables
+def analytic_model_profiles(model_cfg: Any, max_tp: int) -> Optional[Tuple[dict, dict]]:
+    """(time_config, memory_config) for GalvatronSearchEngine synthesized
+    from the model config alone — the no-profiles fallback, built on the
+    same analytic parameter/activation tables the strategy linter's GLS101
+    estimate uses, so the elastic re-search and the linter agree on what
+    fits. Timing is a flops-proportional constant: with no profiled tables
+    every strategy's compute scales identically, so relative comparisons
+    (what the DP needs) remain meaningful."""
+    from galvatron_tpu.analysis.strategy_lint import (
+        _analytic_activation_dict,
+        _analytic_parameter_mb,
+    )
+
+    param_mb = _analytic_parameter_mb(model_cfg)
+    act = _analytic_activation_dict(model_cfg, max_tp)
+    if param_mb is None or not act:
+        return None
+    h = getattr(model_cfg, "hidden_size", 1024)
+    s = getattr(model_cfg, "max_seq_len", 2048)
+    # ~12*s*h^2 flops/token forward; an arbitrary-but-fixed throughput turns
+    # it into ms/layer/sample (only ratios matter without profiles)
+    fwd_ms = 12.0 * s * h * h / 1e12 * 1e3
+    time_config = {"layertype_0": max(fwd_ms, 1e-3), "other_time": max(fwd_ms, 1e-3)}
+    states = {}
+    t = 1
+    while t <= max_tp:
+        # embed/head model states (params + grads + adam moments ~ 16 bytes/
+        # param fp32-master) sharded over vocab tp
+        vocab = getattr(model_cfg, "vocab_size", 0) or 0
+        states[t] = vocab * h * 16.0 / 2**20 / t
+        t *= 2
+    act_other = {k: v for k, v in act.items() if k != "checkpoint"}
+    memory_config = {
+        "layertype_0": {
+            "parameter_size": param_mb,
+            "tp_activation_per_bsz_dict": dict(act),
+        },
+        "other_memory_pp_off": {"model_states": dict(states), "activation": dict(act_other)},
+        "other_memory_pp_on": {
+            "first_stage": {"model_states": {k: v / 2 for k, v in states.items()},
+                            "activation": {k: v / 2 for k, v in act_other.items()}},
+            "last_stage": {"model_states": {k: v / 2 for k, v in states.items()},
+                           "activation": {k: v / 2 for k, v in act_other.items()}},
+        },
+    }
+    return time_config, memory_config
+
+
+def analytic_hardware_profiles(world: int) -> Tuple[dict, dict, dict]:
+    """(allreduce, p2p, overlap) coefficient JSONs for the no-profiles
+    fallback: flat plausible ICI bandwidths — without measurements every
+    collective is priced identically per byte, which still ranks strategies
+    by communication VOLUME (the dominant analytic signal)."""
+    allreduce = {}
+    size = 2
+    while size <= world:
+        allreduce["allreduce_size_%d_consec_1" % size] = 100.0
+        allreduce["allreduce_size_%d_consec_0" % size] = 80.0
+        size *= 2
+    p2p = {}
+    size = 2
+    while size <= world:
+        p2p["pp_size_%d" % size] = 120.0
+        size *= 2
+    return allreduce, p2p, {"overlap_coe": 1.1}
+
+
+def _pow2_floor(n: int) -> int:
+    p = 1
+    while p * 2 <= n:
+        p *= 2
+    return p
+
+
+def search_surviving_strategy(
+    model_cfg: Any,
+    live_world: int,
+    global_bsz: int,
+    memory_budget_gb: float,
+    model_type: str = "model",
+    config_dir: Optional[str] = None,
+    default_dp_type: str = "ddp",
+    logger=None,
+) -> Optional[HybridParallelConfig]:
+    """Re-run the strategy search for the surviving world size under the
+    same global batch and memory budget. Profiled tables are used when
+    `config_dir` has them for this model; otherwise the analytic fallback.
+    Returns None when nothing fits (the caller turns that into GLS203)."""
+    from galvatron_tpu.search.engine import GalvatronSearchEngine, SearchArgs
+
+    heads = getattr(model_cfg, "num_heads", None) or 1
+    num_layers = getattr(model_cfg, "num_layers", 1)
+    seq_len = getattr(model_cfg, "max_seq_len", 2048)
+    hidden = getattr(model_cfg, "hidden_size", 1024)
+    # cap tp at the largest power of two dividing the head count so every
+    # emitted strategy passes the model-aware GLS007 check
+    max_tp = 1
+    while max_tp * 2 <= min(heads, live_world) and heads % (max_tp * 2) == 0:
+        max_tp *= 2
+    args = SearchArgs(
+        memory_constraint=memory_budget_gb,
+        settle_bsz=global_bsz,  # the batch is part of the training trajectory
+        settle_chunk=None,
+        max_tp_deg=max_tp,
+        max_pp_deg=min(_pow2_floor(num_layers), live_world),
+        default_dp_type=default_dp_type,
+        sp_space="tp",
+    )
+    engine = GalvatronSearchEngine(
+        args, live_world,
+        [{"hidden_size": hidden, "seq_len": seq_len, "layer_num": num_layers}],
+        config_dir=config_dir or "configs", model_name=model_type, logger=logger,
+    )
+    profiles = None
+    if config_dir:
+        profiles = _load_profiled_tables(model_cfg, model_type, config_dir, live_world)
+    if profiles is None:
+        synth = analytic_model_profiles(model_cfg, max_tp=live_world)
+        if synth is None:
+            return None
+        time_cfg, mem_cfg = synth
+        allreduce, p2p, overlap = analytic_hardware_profiles(live_world)
+    else:
+        time_cfg, mem_cfg, allreduce, p2p, overlap = profiles
+    engine.set_model_profiles(time_cfg, mem_cfg)
+    engine.set_hardware_profiles(allreduce, p2p, overlap)
+    engine.initialize_search_engine()
+    result = engine.parallelism_optimization()
+    if result is None:
+        return None
+    return engine.result_to_config(result)
+
+
+def _load_profiled_tables(model_cfg, model_type, config_dir, world):
+    """The profiled-table path of the elastic re-search: the same files the
+    search CLI reads (cli/search.py). None when any required table is
+    missing or unreadable — the analytic fallback takes over."""
+    try:
+        from galvatron_tpu.profiler.model import ModelProfileArgs, ModelProfiler
+        from galvatron_tpu.utils.jsonio import read_json_config
+
+        prof = ModelProfiler(model_cfg, model_name=model_type,
+                             args=ModelProfileArgs(config_dir=config_dir))
+        mp = prof.config_paths()
+        time_cfg = read_json_config(mp["computation"])
+        mem_cfg = read_json_config(mp["memory"])
+        tag = "%dchips" % world
+        allreduce = read_json_config(
+            os.path.join(config_dir, "allreduce_bandwidth_%s.json" % tag))
+        p2p_path = os.path.join(config_dir, "p2p_bandwidth_%s.json" % tag)
+        p2p = read_json_config(p2p_path) if os.path.exists(p2p_path) else None
+        ov_path = os.path.join(config_dir, "overlap_coefficient.json")
+        overlap = read_json_config(ov_path) if os.path.exists(ov_path) else None
+        return time_cfg, mem_cfg, allreduce, p2p, overlap
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
+# ------------------------------------------------------------- resume planning
+@dataclass
+class ElasticPlan:
+    """What resolve_resume_strategy decided: run `hp` now; the checkpoint
+    was written under `saved_hp` (load_checkpoint's cross-strategy restore
+    needs it)."""
+
+    action: str  # "match" | "strategy_file" | "search"
+    hp: HybridParallelConfig
+    saved_hp: HybridParallelConfig
+    provenance: Dict[str, Any]
+    ckpt_iteration: Optional[int] = None
+
+    @property
+    def cross_strategy(self) -> bool:
+        return self.action != "match"
+
+
+def _budget_refusal(hp, model_cfg, budget_gb) -> Optional[D.Diagnostic]:
+    """GLS203 when the strategy's estimated memory exceeds the budget on the
+    surviving mesh — the linter only warns (GLS101); a refusal is right here
+    because proceeding would OOM minutes into the resumed run."""
+    from galvatron_tpu.analysis.strategy_lint import estimate_stage_memory_mb
+
+    stage_mb = estimate_stage_memory_mb(hp, model_cfg)
+    if stage_mb is None or not budget_gb:
+        return None
+    worst = max(stage_mb)
+    if worst > budget_gb * 1024.0:
+        return D.make(
+            "GLS203", "stage memory estimated at %.2f GB exceeds the %.1f GB "
+            "budget on the surviving %d-device mesh; lower the batch/enable "
+            "checkpointing via --elastic_strategy, or raise "
+            "--elastic_memory_gb" % (worst / 1024.0, budget_gb, hp.world_size),
+        )
+    return None
+
+
+def resolve_resume_strategy(
+    args: Any,
+    model_cfg: Any,
+    live_world: int,
+    opt_args: Any = None,
+) -> ElasticPlan:
+    """Decide the strategy for an elastic resume (--elastic resume|search).
+
+    Raises DiagnosticError (GLS2xx) whenever resuming would corrupt or
+    silently degrade training; the train CLI maps that to exit code 2."""
+    from galvatron_tpu.runtime import checkpoint as ckpt
+
+    mode = getattr(args, "elastic", "off")
+    it, prov = ckpt.read_provenance(args.load)
+    if prov is None:
+        raise D.DiagnosticError([D.make(
+            "GLS204", "checkpoint %s has no provenance manifest — it predates "
+            "elastic resume; resume it on the original mesh with --elastic "
+            "off (one save there upgrades it)" % args.load,
+        )])
+    live_digest = model_config_digest(model_cfg)
+    if prov.get("model_digest") and prov["model_digest"] != live_digest:
+        raise D.DiagnosticError([D.make(
+            "GLS201", "checkpoint %s was written for a different model "
+            "config (digest %s.. != %s..): elastic resume re-plans the "
+            "PARALLELISM, never the model" % (
+                args.load, prov["model_digest"][:12], live_digest[:12]),
+        )])
+    if opt_args is not None and prov.get("optimizer", {}).get("digest"):
+        if prov["optimizer"]["digest"] != optimizer_digest(opt_args):
+            print(
+                "elastic: optimizer hyperparams differ from the checkpoint's "
+                "(%s); continuing — the structural guard still applies"
+                % prov["optimizer"].get("kind", "?")
+            )
+    saved_world = int(prov.get("world_size", live_world))
+    exec_kw = dict(
+        scan_layers=getattr(args, "scan_layers", True),
+        remat_policy=getattr(args, "remat_policy", "full"),
+        mixed_precision=getattr(args, "mixed_precision", "bf16"),
+    )
+    saved_hp = HybridParallelConfig.from_json(
+        dict(prov["strategy"]), world_size=saved_world, **exec_kw)
+    budget = getattr(args, "elastic_memory_gb", None) or prov.get(
+        "memory_budget_gb") or DEFAULT_MEMORY_GB
+
+    if saved_world == live_world:
+        # nothing changed: resume under the saved strategy, bitwise identical
+        # to a plain --load (the checkpoint's strategy wins over GLOBAL flags
+        # so a stale launch script cannot silently fork the trajectory)
+        return ElasticPlan("match", saved_hp, saved_hp, prov, it)
+
+    strategy_file = getattr(args, "elastic_strategy", None)
+    if strategy_file:
+        hp = HybridParallelConfig.from_json(
+            strategy_file, world_size=live_world, **exec_kw)
+        if hp.global_bsz != saved_hp.global_bsz:
+            print(
+                "elastic: --elastic_strategy changes global_bsz %d -> %d; "
+                "the loss trajectory will not be comparable to the original "
+                "run" % (saved_hp.global_bsz, hp.global_bsz)
+            )
+        action = "strategy_file"
+    elif mode == "search":
+        hp = search_surviving_strategy(
+            model_cfg, live_world, saved_hp.global_bsz, budget,
+            model_type=getattr(args, "model_type", "model"),
+            config_dir=getattr(args, "config_dir", None),
+            default_dp_type=saved_hp.default_dp_type,
+        )
+        if hp is None:
+            raise D.DiagnosticError([D.make(
+                "GLS203", "no strategy for %d surviving devices fits "
+                "global_bsz=%d under the %.1f GB budget; shrink the batch "
+                "with --elastic_strategy or raise --elastic_memory_gb"
+                % (live_world, saved_hp.global_bsz, budget),
+            )])
+        for k, v in exec_kw.items():
+            setattr(hp, k, v)
+        action = "search"
+    else:
+        raise D.DiagnosticError([D.make(
+            "GLS205", "world size changed %d -> %d: pass a replacement "
+            "strategy via --elastic_strategy, or let the search engine "
+            "re-plan with --elastic search" % (saved_world, live_world),
+        )])
+
+    from galvatron_tpu.analysis import strategy_lint as _slint
+
+    report = _slint.lint_hp(hp, model_cfg=model_cfg)
+    if not report.ok:
+        raise D.DiagnosticError(report.errors)
+    if action == "strategy_file":
+        # the search engine enforced the budget itself (possibly against
+        # profiled tables); a hand-supplied strategy gets the analytic check
+        refusal = _budget_refusal(hp, model_cfg, budget)
+        if refusal is not None:
+            raise D.DiagnosticError([refusal])
+    return ElasticPlan(action, hp, saved_hp, prov, it)

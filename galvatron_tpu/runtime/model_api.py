@@ -88,6 +88,21 @@ class HybridParallelModel:
         """Sharded init: jit with out_shardings so each device materialises
         only its shard (the analogue of meta-device init + shard streaming,
         reference runtime/initialize.py:8-112)."""
+        if self.init_fn is None and self.hp.pp > 1:
+            # jax 0.4.37 GSPMD hazard: fusing the per-layer init with the
+            # jnp.stack into `stages` in ONE jitted program whose
+            # out_shardings put the pp axis on the new stacked dim produces
+            # silently wrong values in some stacked entries (eager init is
+            # correct; measured 0.2-0.3 absolute error on layer kernels,
+            # the test_pipelined_bert_mlm parity failure). Init the
+            # canonical per-layer tree jitted, stack it op-by-op OUTSIDE
+            # the jitted program, then place onto the stacked shardings —
+            # the same path the parity-test fixtures use.
+            from galvatron_tpu.parallel.pipeline import stack_params
+
+            params = jax.jit(lambda r: M.init_model_params(r, self.cfg))(rng)
+            params["stages"] = stack_params(params.pop("layers"), self.hp)
+            return jax.device_put(params, self.shardings())
         init = jax.jit(self._init_fn, out_shardings=self.shardings())
         return init(rng)
 

@@ -16,5 +16,6 @@ cd "$(dirname "$0")/.."
 exec env JAX_PLATFORMS=cpu python -m galvatron_tpu.cli lint \
     --code \
     --world_size 8 \
+    --ckpt tests/analysis/fixtures/ckpt_valid \
     tests/analysis/fixtures/valid/*.json \
     "$@"

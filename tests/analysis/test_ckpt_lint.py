@@ -1,0 +1,101 @@
+"""Offline checkpoint auditor (`lint --ckpt`, GLS21x): manifest integrity,
+provenance consistency, embedded-strategy lint — no arrays restored."""
+
+import json
+import os
+import shutil
+
+import jax.numpy as jnp
+import pytest
+
+from galvatron_tpu.analysis.ckpt_lint import audit_checkpoint_dir
+from galvatron_tpu.cli.lint import run
+from galvatron_tpu.config.strategy import HybridParallelConfig
+from galvatron_tpu.runtime import checkpoint as ck
+from galvatron_tpu.runtime import elastic as els
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "ckpt_valid")
+
+
+class _Cfg:
+    hidden_size = 32
+    num_heads = 2
+    num_layers = 2
+    vocab_size = 64
+    max_seq_len = 16
+
+
+def _real_ckpt(tmp_path, with_provenance=True):
+    d = str(tmp_path / "ck")
+    hp = HybridParallelConfig.uniform(8, 2, global_bsz=8)
+    prov = els.build_provenance(hp, _Cfg(), memory_budget_gb=16.0) if with_provenance else None
+    ck.save_checkpoint(d, 2, {"w": jnp.arange(4.0)}, hp=hp, provenance=prov)
+    return d
+
+
+def codes(report):
+    return report.codes()
+
+
+def test_shipped_fixture_is_clean():
+    report = audit_checkpoint_dir(FIXTURE)
+    assert report.ok and not report.warnings, report.render()
+
+
+def test_real_checkpoint_with_provenance_is_clean(tmp_path):
+    report = audit_checkpoint_dir(_real_ckpt(tmp_path))
+    assert report.ok and not report.warnings, report.render()
+
+
+def test_missing_provenance_warns(tmp_path):
+    report = audit_checkpoint_dir(_real_ckpt(tmp_path, with_provenance=False))
+    assert report.ok
+    assert "GLS213" in codes(report)
+
+
+def test_torn_step_flagged(tmp_path):
+    d = _real_ckpt(tmp_path)
+    os.remove(ck._manifest_path(d, 2))
+    report = audit_checkpoint_dir(d)
+    assert not report.ok
+    assert "GLS210" in codes(report)
+
+
+def test_stray_and_orphan_entries_warn(tmp_path):
+    d = _real_ckpt(tmp_path)
+    os.makedirs(os.path.join(d, "editor_droppings"))
+    shutil.rmtree(os.path.join(d, "2"))  # manifest now orphaned
+    report = audit_checkpoint_dir(d)
+    assert "GLS211" in codes(report)
+
+
+def test_bad_provenance_strategy_flagged(tmp_path):
+    d = _real_ckpt(tmp_path)
+    path = ck._manifest_path(d, 2)
+    with open(path) as f:
+        manifest = json.load(f)
+    manifest["provenance"]["strategy"]["tp_sizes_enc"] = "3,1"  # 3 won't tile 8
+    manifest["provenance"]["mesh_shape"] = {"pp": 2, "m0": 2}  # 4 != world 8
+    with open(path, "w") as f:
+        json.dump(manifest, f)
+    report = audit_checkpoint_dir(d)
+    assert not report.ok
+    got = codes(report)
+    assert "GLS212" in got  # mesh_shape/world mismatch
+    assert "GLS002" in got  # embedded strategy fails its own lint
+
+
+def test_cli_ckpt_flag_exit_codes(tmp_path, capsys):
+    assert run(["--ckpt", FIXTURE]) == 0
+    capsys.readouterr()
+    d = _real_ckpt(tmp_path)
+    os.remove(ck._manifest_path(d, 2))
+    assert run(["--ckpt", d]) == 1
+    assert "GLS210" in capsys.readouterr().out
+    assert run(["--ckpt", str(tmp_path / "nope")]) == 2
+
+
+def test_cli_ckpt_json_output(capsys):
+    assert run(["--ckpt", FIXTURE, "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["summary"]["errors"] == 0

@@ -68,3 +68,104 @@ def test_latest_iteration(tmp_path):
     ck.save_checkpoint(str(tmp_path / "c"), 1, tree)
     ck.save_checkpoint(str(tmp_path / "c"), 5, tree)
     assert ck.latest_iteration(str(tmp_path / "c")) == 5
+
+
+# ------------------------------------------------------------ GC/resume race
+def _save_steps(d, steps):
+    tree = {"w": jnp.arange(4.0)}
+    for s in steps:
+        ck.save_checkpoint(d, s, tree)
+    return tree
+
+
+def test_gc_never_deletes_newest_intact_step(tmp_path):
+    """With the newest steps torn (manifest never committed), GC keeping
+    the latest K by NUMBER must still preserve the newest intact step —
+    it is the only state a fallback restore can use."""
+    import os
+
+    d = str(tmp_path / "c")
+    tree = _save_steps(d, [1, 2, 3, 4])
+    for s in (3, 4):  # torn: orbax dir exists, manifest gone
+        os.remove(ck._manifest_path(d, s))
+    deleted = ck.gc_checkpoints(d, keep_latest_k=1)
+    assert 2 not in deleted
+    assert ck.intact_iterations(d) == [2]
+    # the fallback restore still works after GC
+    out, _, meta = ck.load_checkpoint(d, params_target=tree)
+    assert meta["iteration"] == 2
+
+
+def test_gc_protects_step_being_restored(tmp_path):
+    d = str(tmp_path / "c")
+    _save_steps(d, [1, 2, 3])
+    ck._RESTORING.add(1)
+    try:
+        deleted = ck.gc_checkpoints(d, keep_latest_k=1)
+    finally:
+        ck._RESTORING.discard(1)
+    assert 1 not in deleted and 2 in deleted
+    with ck._manager(d) as mgr:
+        assert 1 in mgr.all_steps()
+    # explicit protect= works the same way
+    assert ck.gc_checkpoints(d, keep_latest_k=1, protect={1}) == []
+
+
+def test_gc_tolerates_stray_directories(tmp_path):
+    import os
+
+    d = str(tmp_path / "c")
+    _save_steps(d, [1, 2])
+    os.makedirs(os.path.join(d, "not_a_step"))
+    os.makedirs(os.path.join(d, "tmp.orbax-checkpoint-tmp-123"))
+    deleted = ck.gc_checkpoints(d, keep_latest_k=1)  # must not raise
+    assert deleted == [1]
+    tree = {"w": jnp.arange(4.0)}
+    out, _, meta = ck.load_checkpoint(d, params_target=tree)
+    assert meta["iteration"] == 2
+
+
+def test_restore_retries_transient_manifest_io(tmp_path):
+    """Satellite: restore-side I/O gets the same retry/backoff saves have
+    had since PR 1, counted in ResilienceCounters."""
+    from galvatron_tpu.runtime import resilience as rsl
+    from tests.runtime.fault_injection import flaky_calls
+
+    d = str(tmp_path / "c")
+    tree = _save_steps(d, [2])
+    counters = rsl.ResilienceCounters()
+    policy = rsl.RetryPolicy(retries=3, base_delay_s=0.0)
+    with flaky_calls(ck, "_read_manifest_raising", failures=2, exc=OSError):
+        out, _, meta = ck.load_checkpoint(
+            d, params_target=tree, retry_policy=policy, counters=counters)
+    assert meta["iteration"] == 2
+    assert counters.retries == 2
+
+
+def test_restore_retry_budget_exhaustion_marks_torn(tmp_path):
+    """A manifest read that stays broken past the retry budget marks the
+    step torn (fallback), it does not crash the restore."""
+    from galvatron_tpu.runtime import resilience as rsl
+    from tests.runtime.fault_injection import flaky_calls
+
+    d = str(tmp_path / "c")
+    tree = _save_steps(d, [2, 4])
+    counters = rsl.ResilienceCounters()
+    policy = rsl.RetryPolicy(retries=1, base_delay_s=0.0)
+
+    orig = ck._read_manifest_raising
+
+    def flaky_step4(ckpt_dir, iteration):
+        if iteration == 4:
+            raise OSError("injected permanent failure")
+        return orig(ckpt_dir, iteration)
+
+    ck._read_manifest_raising = flaky_step4
+    try:
+        out, _, meta = ck.load_checkpoint(
+            d, params_target=tree, retry_policy=policy, counters=counters)
+    finally:
+        ck._read_manifest_raising = orig
+    assert meta["iteration"] == 2
+    assert meta["torn_iterations"] == [4]
+    assert counters.retries == 1

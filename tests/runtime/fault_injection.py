@@ -166,13 +166,13 @@ def tear_checkpoint(ckpt_dir: str, iteration: int, mode: str = "manifest"):
 
 # --------------------------------------------------------- subprocess driver
 def tiny_argv(train_iters: int, save=None, load=None, save_interval=0,
-              extra: Sequence[str] = ()):
+              world: int = 1, extra: Sequence[str] = ()):
     argv = [
         "--model_type", "llama", "--set_model_config_manually", "1",
         "--hidden_size", "32", "--num_attention_heads", "2", "--num_layers", "1",
         "--vocab_size", "64", "--seq_length", "16", "--mixed_precision", "fp32",
         "--global_train_batch_size", "2", "--train_iters", str(train_iters),
-        "--lr", "1e-2", "--world_size", "1",
+        "--lr", "1e-2", "--world_size", str(world),
     ]
     if save:
         argv += ["--save", save]
@@ -193,8 +193,20 @@ def main(argv=None):
     p.add_argument("--save_interval", type=int, default=0)
     p.add_argument("--kill_at", type=int, default=4)
     p.add_argument("--sigterm_at", type=int, default=2)
+    p.add_argument("--devices", type=int, default=1,
+                   help="virtual CPU device count for THIS process — the "
+                        "hardware-loss simulation runs save and resume with "
+                        "different counts")
+    p.add_argument("--world", type=int, default=1)
+    p.add_argument("--elastic", default=None, choices=(None, "resume", "search"),
+                   help="forwarded as --elastic for the resume scenario")
     a = p.parse_args(argv)
 
+    if a.devices > 1:
+        flags = os.environ.get("XLA_FLAGS", "")
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=%d" % a.devices
+        ).strip()
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -203,13 +215,29 @@ def main(argv=None):
     from galvatron_tpu.cli.arguments import initialize_galvatron
     from galvatron_tpu.cli.train import train
 
+    extra = ["--elastic", a.elastic] if a.elastic else ()
     args = initialize_galvatron(mode="train_dist", argv=tiny_argv(
-        a.iters, save=a.save, load=a.load, save_interval=a.save_interval))
+        a.iters, save=a.save, load=a.load, save_interval=a.save_interval,
+        world=a.world, extra=extra))
     if a.scenario == "kill_mid_save":
         arm_kill_before_manifest(a.kill_at)
     elif a.scenario == "sigterm":
         args.fault_hooks = sigterm_hooks(a.sigterm_at)
-    summary = train(args)
+    try:
+        summary = train(args)
+    except Exception as e:
+        # the CLI's elastic-refusal contract (cli/train.py main): GLS2xx
+        # diagnostics exit 2 so supervisors can distinguish "needs operator
+        # input" from "retry me"
+        from galvatron_tpu.analysis.diagnostics import DiagnosticError
+
+        if isinstance(e, DiagnosticError) and any(
+            d.code.startswith("GLS2") for d in e.diagnostics
+        ):
+            for d in e.diagnostics:
+                print(d.format(), file=sys.stderr)
+            return 2
+        raise
     print("LOSSES=" + json.dumps(summary["losses"]))
     print("RESILIENCE=" + json.dumps(summary["resilience"]))
     print("INTERRUPTED=" + json.dumps(summary.get("interrupted")))

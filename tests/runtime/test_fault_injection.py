@@ -92,3 +92,63 @@ def test_sigterm_emergency_save_and_clean_exit(tmp_path):
 
     resumed = run_scenario("--scenario", "resume", "--iters", "6", "--load", d)
     np.testing.assert_array_equal(parse(resumed.stdout, "LOSSES"), ref_losses[3:])
+
+
+def test_kill_mid_save_then_elastic_resume_with_fewer_devices(tmp_path):
+    """The full hardware-loss story: a 2-device run is SIGKILLed in the
+    torn-save window at iteration 4, and the resume process only has ONE
+    device — `--elastic search` re-plans the strategy for the surviving
+    world, falls back to the intact step 2, and continues the trajectory
+    (dp2 -> dp1 relayout keeps the same global batch; losses match the
+    uninterrupted 2-device run within cross-strategy tolerance)."""
+    from galvatron_tpu.runtime import checkpoint as ck
+
+    d = str(tmp_path / "ck")
+    ref = run_scenario("--scenario", "train", "--iters", "6",
+                       "--devices", "2", "--world", "2")
+    ref_losses = parse(ref.stdout, "LOSSES")
+
+    proc = run_scenario(
+        "--scenario", "kill_mid_save", "--iters", "6", "--save", d,
+        "--save_interval", "2", "--kill_at", "4",
+        "--devices", "2", "--world", "2", expect_rc=None,
+    )
+    assert proc.returncode == -signal.SIGKILL, (proc.returncode, proc.stderr[-2000:])
+    assert ck.intact_iterations(d) == [2]
+    it, prov = ck.read_provenance(d)
+    assert it == 2 and prov["world_size"] == 2  # provenance survived the kill
+
+    resumed = run_scenario(
+        "--scenario", "resume", "--iters", "6", "--load", d,
+        "--devices", "1", "--world", "1", "--elastic", "search",
+    )
+    res_losses = parse(resumed.stdout, "LOSSES")
+    counters = parse(resumed.stdout, "RESILIENCE")
+    assert counters["torn_checkpoints_skipped"] == 1
+    assert len(res_losses) == 4  # re-ran steps 2..5 on the shrunken mesh
+    np.testing.assert_allclose(res_losses, ref_losses[2:], rtol=5e-3, atol=2e-4)
+
+
+def test_elastic_resume_without_provenance_exits_2(tmp_path):
+    """The refusal contract crosses the process boundary: a pre-elastic
+    checkpoint (no provenance) with --elastic search exits 2 with a GLS204
+    diagnostic, not a traceback-exit-1 or a silent fresh start."""
+    d = str(tmp_path / "ck")
+    run_scenario("--scenario", "train", "--iters", "2", "--save", d)
+    # strip the provenance from the manifest: simulates a PR-1-era checkpoint
+    import json as _json
+
+    from galvatron_tpu.runtime import checkpoint as ck
+
+    path = ck._manifest_path(d, 2)
+    with open(path) as f:
+        manifest = _json.load(f)
+    manifest.pop("provenance", None)
+    with open(path, "w") as f:
+        _json.dump(manifest, f)
+    proc = run_scenario(
+        "--scenario", "resume", "--iters", "4", "--load", d,
+        "--devices", "2", "--world", "2", "--elastic", "search",
+        expect_rc=2,
+    )
+    assert "GLS204" in proc.stderr
