@@ -111,3 +111,89 @@ def child_pythonpath(env: dict, repo_root: str) -> str:
 
 if sys.version_info < (3, 9):  # pragma: no cover
     raise RuntimeError("python >= 3.9 required")
+
+
+# ===================================================================== gate
+# MFU-regression gate (ROADMAP item 1): compare a bench payload against the
+# most recent non-empty BENCH_r*.json so the perf trajectory cannot silently
+# decay again (BENCH_r04/r05 shipped zero numbers and nobody noticed until
+# re-anchor). stdlib-only: runs in the orchestrator.
+
+def _get_path(d, dotted):
+    for part in dotted.split("."):
+        if not isinstance(d, dict) or part not in d:
+            return None
+        d = d[part]
+    return d
+
+
+# (dotted path under the payload, higher_is_better). The headline value is
+# keyed by its metric name so a SMOKE payload never compares against a
+# full-shape baseline.
+GATE_METRICS = (
+    ("extra.train_step.mfu", True),
+    ("extra.train_step.tokens_per_sec_per_chip", True),
+    ("extra.train_loop.dispatch_ahead.steps_per_s", True),
+)
+
+
+def perf_metrics(payload):
+    """name -> (value, higher_is_better) for every comparable number the
+    payload carries. Absent/None entries are simply not in the dict, so
+    absent-numbers rounds contribute nothing."""
+    out = {}
+    if isinstance(payload.get("value"), (int, float)) and payload.get("metric"):
+        out["value[%s]" % payload["metric"]] = (float(payload["value"]), False)
+    for path, higher in GATE_METRICS:
+        v = _get_path(payload, path)
+        if isinstance(v, (int, float)):
+            out[path] = (float(v), higher)
+    return out
+
+
+def perf_regressions(current_payload, baseline_payload, tolerance=0.1):
+    """Regression report lines, empty when every shared metric is within
+    `tolerance` of the baseline (relative decay for higher-is-better
+    metrics, relative growth for lower-is-better)."""
+    cur = perf_metrics(current_payload or {})
+    base = perf_metrics(baseline_payload or {})
+    out = []
+    for name in sorted(set(cur) & set(base)):
+        c, higher = cur[name]
+        b, _ = base[name]
+        if b <= 0:
+            continue
+        if higher and c < b * (1.0 - tolerance):
+            out.append("%s: %.6g -> %.6g (-%.1f%%, tolerance %.0f%%)"
+                       % (name, b, c, (1.0 - c / b) * 100.0, tolerance * 100.0))
+        elif not higher and c > b * (1.0 + tolerance):
+            out.append("%s: %.6g -> %.6g (+%.1f%%, tolerance %.0f%%)"
+                       % (name, b, c, (c / b - 1.0) * 100.0, tolerance * 100.0))
+    return out
+
+
+def load_latest_baseline(glob_pattern):
+    """(path, payload) of the newest baseline round that actually carries
+    numbers, else None. Accepts both the raw bench JSON-line shape and the
+    perf driver's wrapper ({"n": round, "parsed": {...}}); rounds whose
+    parsed payload is null or number-free (the wedged-tunnel rounds) are
+    tolerated and skipped."""
+    import glob as _glob
+
+    candidates = []
+    for path in _glob.glob(glob_pattern):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        payload = doc.get("parsed", doc) if isinstance(doc, dict) else None
+        if not isinstance(payload, dict) or not perf_metrics(payload):
+            continue
+        order = doc.get("n") if isinstance(doc.get("n"), (int, float)) else None
+        candidates.append(((order is None, order if order is not None else path), path, payload))
+    if not candidates:
+        return None
+    candidates.sort(key=lambda t: t[0])
+    _, path, payload = candidates[-1]
+    return path, payload

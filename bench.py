@@ -47,6 +47,8 @@ from _bench_util import (
     apply_jax_platforms_override,
     interpret_ctx_factory,
     kill_group,
+    load_latest_baseline,
+    perf_regressions,
     run_isolated,
 )
 
@@ -74,17 +76,9 @@ L7B_BATCH = 1 if SMOKE else 4
 # device (same differencing rationale as the layer-fwd metric)
 STEPS_PER_CALL = 1 if SMOKE else 8
 
-# peak dense bf16 matmul throughput per chip, FLOP/s
-PEAK_FLOPS_BY_KIND = {
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,
-    "TPU v5e": 197e12,
-    "TPU v5": 459e12,
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,
-    "TPU v6e": 918e12,
-    "TPU7x": 2307e12,
-}
+# peak FLOP/s per chip: the obs/flops.py registry is the single source of
+# truth now (sections import it lazily — this orchestrator never imports
+# galvatron_tpu, whose package init pulls in jax)
 
 
 # =========================================================================
@@ -171,7 +165,7 @@ def section_layer_fwd():
         t_hi = _time_stack(f_hi, l_hi, x_hi)
         per_round.append((t_hi - t_lo) / (N_HI - N_LO) / BATCH * 1e3)
     med = float(np.median(per_round))
-    return {
+    out = {
         "layer_fwd_ms": float(np.min(per_round)),
         "layer_fwd_ms_median": round(med, 4),
         "layer_fwd_round_spread": round(
@@ -182,6 +176,21 @@ def section_layer_fwd():
         "compile_ms": round(co_lo + co_hi, 1),
         "step_ms": round(t_hi * 1e3, 3),  # steady-state, N_HI-layer stack
     }
+    # forward-only MFU of the N_HI stack (obs/flops.py accounting)
+    from galvatron_tpu.obs import flops as F
+
+    fwd_flops = N_HI * F.layer_fwd_flops(
+        hidden=HIDDEN, num_heads=HEADS, seq_len=SEQ, tokens=BATCH * SEQ,
+        causal=True, swiglu=False,
+    )
+    peak, _kind = _peak_flops()
+    fps = F.flops_per_s(fwd_flops, t_hi * 1e3)
+    if fps:
+        out["model_flops_per_s"] = round(fps, 1)
+    util = F.mfu(fwd_flops, t_hi * 1e3, peak)
+    if util is not None:
+        out["mfu_fwd"] = round(util, 4)
+    return out
 
 
 def _l7b_setup():
@@ -211,22 +220,23 @@ def _l7b_flops_tokens(layers):
     import jax
     import numpy as np
 
+    from galvatron_tpu.obs import flops as F
+
     tokens = L7B_BATCH * L7B_SEQ
     n_params = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(layers))
-    # model FLOPs: 6 * params * tokens (fwd 2x + bwd 4x) + causal attention
-    # 12 * L * S * H * tokens * 0.5 (PaLM appendix-B convention)
-    flops = 6.0 * n_params * tokens + 12 * L7B_LAYERS * L7B_SEQ * L7B_HIDDEN * tokens * 0.5
+    # model FLOPs (PaLM appendix-B convention), via the shared accounting
+    flops = F.train_flops_from_params(
+        n_params, tokens, L7B_LAYERS, L7B_SEQ, L7B_HIDDEN, causal=True)
     return flops, tokens, n_params
 
 
 def _peak_flops():
     import jax
 
+    from galvatron_tpu.obs import flops as F
+
     kind = jax.devices()[0].device_kind
-    for k, v in PEAK_FLOPS_BY_KIND.items():
-        if kind.lower().startswith(k.lower()):
-            return v, kind
-    return None, kind
+    return F.peak_flops_for(kind), kind
 
 
 def section_train_step():
@@ -284,6 +294,7 @@ def section_train_step():
         "compile_ms": round(compile_ms, 1),
         "steps_per_call": STEPS_PER_CALL,
         "tokens_per_sec_per_chip": round(tokens / step_s, 1),
+        "model_flops_per_s": round(flops / step_s, 1),
         "mfu": round(flops / step_s / peak, 4) if peak else None,
         "device_kind": kind,
         "params": n_params,
@@ -349,6 +360,18 @@ def section_breakdown():
     step_ms = os.environ.get("GALVATRON_BENCH_STEP_MS")
     if step_ms:
         out["bwd_plus_overhead_ms"] = round(float(step_ms) - out["fwd_ms"] - out["adam_ms"], 2)
+    # forward-slot MFU: fwd model flops are exactly 1/3 of the train-step
+    # convention (fwd + 2x bwd)
+    from galvatron_tpu.obs import flops as F
+
+    flops, _tokens, _n = _l7b_flops_tokens(layers)
+    peak, _kind = _peak_flops()
+    fps = F.flops_per_s(flops / 3.0, t_fwd * 1e3)
+    if fps:
+        out["fwd_model_flops_per_s"] = round(fps, 1)
+    util = F.mfu(flops / 3.0, t_fwd * 1e3, peak)
+    if util is not None:
+        out["mfu_fwd"] = round(util, 4)
     return out
 
 
@@ -411,13 +434,25 @@ def section_masked_flash():
         return float(np.min(ts)) / K * 1e3
 
     t_plain, t_seg, t_xla = t(f_plain), t(f_seg), t(f_xla)
-    return {
+    out = {
         "seq": S_,
         "unmasked_flash_ms": round(t_plain, 3),
         "masked_seg_flash_ms": round(t_seg, 3),
         "masked_xla_ms": round(t_xla, 3),
         "masked_vs_unmasked": round(t_seg / max(t_plain, 1e-9), 3),
     }
+    # attention arithmetic throughput (scores + weighted sum, non-causal)
+    from galvatron_tpu.obs import flops as F
+
+    attn_flops = 4.0 * B_ * NH_ * S_ * S_ * HD_
+    peak, _kind = _peak_flops()
+    fps = F.flops_per_s(attn_flops, t_plain)
+    if fps:
+        out["model_flops_per_s"] = round(fps, 1)
+    util = F.mfu(attn_flops, t_plain, peak)
+    if util is not None:
+        out["mfu_fwd"] = round(util, 4)
+    return out
 
 
 def section_train_loop():
@@ -462,7 +497,17 @@ def section_train_loop():
     latency_ms = round(max(2.0 * probe.get("steady_step_ms", 25.0), 25.0), 1)
     out = {"train_iters": iters, "input_latency_ms_emulated": latency_ms,
            "probe_steady_step_ms": round(probe.get("steady_step_ms", 0.0), 2)}
-    for key, extra in (("sync", ["--no_async_loop"]), ("dispatch_ahead", [])):
+    # third mode: the dispatch-ahead loop with the telemetry sink enabled —
+    # pins the observability overhead (acceptance: <= 2% steps_per_s)
+    import tempfile
+
+    tele_path = os.path.join(tempfile.mkdtemp(prefix="galv_bench_tele_"), "t.jsonl")
+    modes = (
+        ("sync", ["--no_async_loop"]),
+        ("dispatch_ahead", []),
+        ("dispatch_ahead_telemetry", ["--telemetry", tele_path]),
+    )
+    for key, extra in modes:
         args = initialize_galvatron(mode="train_dist", argv=argv + extra)
         args.fault_hooks = latency_hooks(latency_ms)
         s = train(args)
@@ -473,6 +518,10 @@ def section_train_loop():
             "dispatch_ms": round(s.get("dispatch_ms", 0.0), 3),
             "wall_ms_per_iter": round(s.get("wall_ms_per_iter", 0.0), 2),
         }
+        if s.get("model_flops_per_s"):
+            out[key]["model_flops_per_s"] = round(s["model_flops_per_s"], 1)
+        if s.get("mfu") is not None:
+            out[key]["mfu"] = round(s["mfu"], 6)
     sync_b = out["sync"]["host_blocked_ms"]
     ahead_b = out["dispatch_ahead"]["host_blocked_ms"]
     if sync_b > 0:
@@ -480,6 +529,11 @@ def section_train_loop():
     if out["sync"]["steps_per_s"] > 0:
         out["throughput_speedup"] = round(
             out["dispatch_ahead"]["steps_per_s"] / out["sync"]["steps_per_s"], 3
+        )
+    if out["dispatch_ahead"]["steps_per_s"] > 0:
+        out["telemetry_overhead"] = round(
+            1.0 - out["dispatch_ahead_telemetry"]["steps_per_s"]
+            / out["dispatch_ahead"]["steps_per_s"], 4
         )
     return out
 
@@ -504,7 +558,7 @@ DEADLINE_S = float(os.environ.get("GALVATRON_BENCH_DEADLINE", "200" if SMOKE els
 # masked_flash compiles three attention programs through the tunnel
 # (~20-40s each), so it gets headroom; the deadline still caps the total
 SECTION_BUDGETS = {"layer_fwd": 300.0, "train_step": 360.0, "breakdown": 200.0,
-                   "masked_flash": 180.0, "train_loop": 150.0}
+                   "masked_flash": 180.0, "train_loop": 200.0}
 _START = time.time()
 _ACTIVE_CHILD = None  # Popen of the in-flight section, for watchdog cleanup
 
@@ -586,7 +640,7 @@ def main():
             "SMOKE_gpt_layer_fwd_ms_h%d_s%d" % (HIDDEN, SEQ)
             if SMOKE else "gpt_layer_fwd_ms_per_layer_per_sample_h4096_s2048_bf16"
         )
-        print(json.dumps({
+        payload = {
             "metric": metric,
             "value": round(best, 4) if best is not None else None,
             "unit": "ms",
@@ -596,10 +650,46 @@ def main():
                 REFERENCE_MS_PER_LAYER_PER_SAMPLE / best, 4
             ),
             "extra": extra,
-        }))
+        }
+        print(json.dumps(payload))
         sys.stdout.flush()
-        # always 0: a partial bench is a result, not a failure
-        os._exit(0)
+        # MFU-regression gate (opt-in, ROADMAP item 1): compare against the
+        # newest non-empty BENCH_r*.json and FAIL the process on decay beyond
+        # tolerance. Off by default — the wedge-proofing contract ("a partial
+        # bench is a result, not a failure", exit 0) stays the default; the
+        # perf driver enables the gate explicitly.
+        rc = 0
+        if os.environ.get("GALVATRON_BENCH_GATE", "") not in ("", "0", "false", "no"):
+            tol = float(os.environ.get("GALVATRON_BENCH_GATE_TOL", "0.1"))
+            pattern = os.environ.get(
+                "GALVATRON_BENCH_BASELINE_GLOB",
+                os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_r*.json"),
+            )
+            baseline = load_latest_baseline(pattern)
+            if baseline is None:
+                # absent baselines / number-free rounds are tolerated
+                print("MFU-GATE: no usable baseline under %s — pass" % pattern)
+            else:
+                regressions = perf_regressions(payload, baseline[1], tol)
+                for line in regressions:
+                    print("MFU-REGRESSION [vs %s]: %s" % (baseline[0], line))
+                if regressions:
+                    rc = 1
+                else:
+                    print("MFU-GATE: no regression vs %s (tolerance %.0f%%)"
+                          % (baseline[0], tol * 100.0))
+        sys.stdout.flush()
+        os._exit(rc)
+
+    # gate-test seam: canned section results (no measurement children) let
+    # the regression gate's exit-code contract be tested without a chip
+    fake = os.environ.get("GALVATRON_BENCH_FAKE_RESULTS")
+    if fake:
+        with open(fake) as f:
+            canned = json.load(f)
+        results.update(canned.get("results", {}))
+        errors.update(canned.get("errors", {}))
+        emit_and_exit()
 
     # last-resort watchdog: even if the orchestrator itself stalls (e.g. in
     # communicate() on a wedged child), the JSON line with whatever was

@@ -25,6 +25,16 @@ A pure-AST pass (no execution of the linted code) over Python sources:
   rebinding — the buffer backing it may already be aliased to the output
   (the PR-1 anomaly-guard lesson: donated step inputs cannot be "kept" on
   the host side).
+- **GLC006 — ad-hoc logging in runtime library code**: bare ``print(...)``
+  calls and append-mode ``open(..., "a")`` file logging inside
+  ``galvatron_tpu/runtime/`` and ``galvatron_tpu/obs/`` (the rule is
+  path-scoped; CLI drivers and tests may print). Library-layer output must
+  go through the telemetry stream (``obs.telemetry.runtime_log`` / a
+  ``TelemetrySink``) or an injectable ``print_fn``/``log_fn`` parameter
+  (``RuntimeProfiler.log_iteration(print_fn=)``): bare prints are invisible
+  to the structured event stream the report/autotuner layers consume, and
+  per-call append-opens cost a filesystem round trip on hot paths (the
+  ``log_iteration`` reopen bug this rule pins).
 - **GLC005 — blocking host sync in a loop**: driver-side loops that force a
   host<->device round trip every iteration (``float(...)``/``.item()``/
   ``np.asarray(...)`` on values produced by a jitted callable, or any
@@ -537,6 +547,41 @@ class _ModuleLint:
                     file=self.filename, line=node.lineno, key=key,
                 ))
 
+    # ---- GLC006 --------------------------------------------------------
+    def check_runtime_logging(self):
+        """Path-scoped: only library code under galvatron_tpu/runtime/ or
+        galvatron_tpu/obs/ is held to the no-ad-hoc-logging contract."""
+        if "GLC006" not in self.rules or not _GLC006_PATH_RE.search(self.filename):
+            return
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call) or not isinstance(node.func, ast.Name):
+                continue
+            if node.func.id == "print":
+                self.diags.append(D.make(
+                    "GLC006", "bare print() in runtime library code: route "
+                    "output through obs.telemetry (runtime_log / a "
+                    "TelemetrySink event) or an injectable print_fn/log_fn "
+                    "parameter so it reaches the structured event stream",
+                    file=self.filename, line=node.lineno, key="print",
+                ))
+            elif node.func.id == "open":
+                mode = None
+                if (len(node.args) >= 2 and isinstance(node.args[1], ast.Constant)
+                        and isinstance(node.args[1].value, str)):
+                    mode = node.args[1].value
+                for kw in node.keywords:
+                    if (kw.arg == "mode" and isinstance(kw.value, ast.Constant)
+                            and isinstance(kw.value.value, str)):
+                        mode = kw.value.value
+                if mode and mode.startswith("a"):
+                    self.diags.append(D.make(
+                        "GLC006", "append-mode open(..., %r) logging in "
+                        "runtime library code: emit through the telemetry "
+                        "sink (or hold ONE appending handle for the run, "
+                        "like RuntimeProfiler.log_iteration)" % mode,
+                        file=self.filename, line=node.lineno, key="open",
+                    ))
+
     # ---- pragmas -------------------------------------------------------
     def apply_pragmas(self) -> List[D.Diagnostic]:
         out = []
@@ -549,7 +594,11 @@ class _ModuleLint:
         return out
 
 
-ALL_RULES = frozenset({"GLC001", "GLC002", "GLC003", "GLC004", "GLC005"})
+ALL_RULES = frozenset({"GLC001", "GLC002", "GLC003", "GLC004", "GLC005", "GLC006"})
+
+# GLC006 scope: the runtime/observability library layers (posix or windows
+# separators); CLI drivers, analysis tools and tests are exempt by path
+_GLC006_PATH_RE = re.compile(r"(^|[/\\])galvatron_tpu[/\\](runtime|obs)[/\\]")
 
 
 def lint_source(
@@ -570,6 +619,7 @@ def lint_source(
     ml.check_jit_bodies()
     ml.check_donated_reuse()
     ml.check_host_syncs_in_loops()
+    ml.check_runtime_logging()
     return ml.apply_pragmas()
 
 

@@ -64,6 +64,7 @@ CODES: Dict[str, Tuple[str, str]] = {
     "GLC003": (WARNING, "Python control flow on a traced value inside jit"),
     "GLC004": (ERROR, "donated buffer used again after the donating jit call"),
     "GLC005": (WARNING, "blocking host sync inside a loop in driver code"),
+    "GLC006": (WARNING, "ad-hoc print/append-file logging in runtime library code"),
 }
 
 

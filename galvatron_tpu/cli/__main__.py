@@ -11,6 +11,10 @@ Subcommands replace the reference's per-model shell scripts
                        for jax-API drift and jit hazards / audit checkpoint
                        dirs offline (--ckpt: manifest integrity, provenance)
                        (CPU only, no tracing; exits 1 on error diagnostics)
+    report             analyze a telemetry JSONL written by `train
+                       --telemetry`: steady-state step time, MFU, lifecycle
+                       timeline, predicted-vs-measured divergence table
+                       (offline; exits 1 on schema violations)
 """
 
 import sys
@@ -31,6 +35,8 @@ def main():
         from galvatron_tpu.cli.profile import main_hardware as run
     elif cmd == "lint":
         from galvatron_tpu.cli.lint import main as run
+    elif cmd == "report":
+        from galvatron_tpu.obs.report import main as run
     else:
         print("unknown subcommand %r\n%s" % (cmd, __doc__))
         return 2

@@ -130,6 +130,24 @@ def _add_train_args(p: argparse.ArgumentParser):
     g.add_argument("--profile", type=int, default=0, help="enable the runtime profiler")
     g.add_argument("--train_log_dir", type=str, default=None,
                    help="tee rank-0 iteration stats to <dir>/train_<model>.log")
+    # observability (galvatron_tpu/obs): structured telemetry + XLA tracing
+    o = p.add_argument_group("observability")
+    o.add_argument("--telemetry", type=str, default=None,
+                   help="write a schema-versioned JSONL event stream "
+                        "(per-step timing/loss/MFU + lifecycle events) to "
+                        "this path; analyze with `python -m galvatron_tpu.cli "
+                        "report <path>`")
+    o.add_argument("--telemetry_buffer", type=int, default=1024,
+                   help="bounded queue depth of the background telemetry "
+                        "writer (a stalled filesystem back-pressures instead "
+                        "of ballooning memory)")
+    o.add_argument("--xla_trace", type=str, default=None,
+                   help="capture an XLA profiler trace (Perfetto/TensorBoard) "
+                        "into this directory for the --trace_steps window; "
+                        "skipped gracefully on backends that cannot trace")
+    o.add_argument("--trace_steps", type=str, default="3:5",
+                   help="K:N (inclusive) iteration window for --xla_trace; "
+                        "keep it a few steps wide — traces are large")
     g.add_argument("--profile_forward", type=int, default=0)
     g.add_argument("--save_profiled_memory", type=int, default=0)
     g.add_argument("--profile_type", type=str, default="computation", choices=("computation", "memory"))

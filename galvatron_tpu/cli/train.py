@@ -23,7 +23,13 @@ from galvatron_tpu.cli.arguments import (
     initialize_galvatron,
     model_config_from_args,
 )
-from galvatron_tpu.profiler.runtime import RuntimeProfiler
+from galvatron_tpu.obs import flops as obs_flops
+from galvatron_tpu.obs import telemetry
+from galvatron_tpu.profiler.runtime import (
+    RuntimeProfiler,
+    compiled_step_memory_mb,
+    device_memory_stats,
+)
 from galvatron_tpu.runtime import checkpoint as ckpt
 from galvatron_tpu.runtime import resilience as rsl
 from galvatron_tpu.runtime.dataloader import get_train_iterator
@@ -146,7 +152,33 @@ def build_data_iterator(args, fam, cfg, hp, start_step: int = 0,
 
 def train(args) -> dict:
     """Returns a summary dict (losses, timing, resilience counters) for
-    tests/driver use."""
+    tests/driver use. With ``--telemetry <path>`` the run additionally
+    writes a schema-versioned JSONL event stream (obs/telemetry.py): the
+    sink installs process-wide so the checkpoint/elastic/resilience layers'
+    lifecycle events land in the same file as the driver's per-step
+    records."""
+    sink = None
+    if getattr(args, "telemetry", None):
+        sink = telemetry.JsonlSink(
+            args.telemetry, depth=max(int(getattr(args, "telemetry_buffer", 1024) or 1), 1)
+        )
+        telemetry.install(sink)
+    try:
+        return _train(args)
+    finally:
+        if sink is not None:
+            telemetry.uninstall(sink)
+            sink.close()
+
+
+def _parse_trace_steps(spec) -> tuple:
+    """'K:N' -> (K, N) inclusive; a single 'K' traces one step."""
+    lo, _, hi = str(spec or "3:5").partition(":")
+    lo = int(lo)
+    return lo, int(hi) if hi else lo
+
+
+def _train(args) -> dict:
     if getattr(args, "compile_cache", 0):
         from galvatron_tpu.utils.compile_cache import enable_persistent_cache
 
@@ -192,6 +224,27 @@ def train(args) -> dict:
         raise DiagnosticError(_report.errors)
     if jax.process_index() == 0:
         print(hp.describe())
+
+    # --------------------------------------------------------- observability
+    # model-FLOPs + peak registry (obs/flops.py): the constants every MFU
+    # surface (per-step telemetry, profiler summary) derives from. None for
+    # families the analytic model cannot describe — MFU is then omitted.
+    step_flops = obs_flops.train_step_flops(cfg, hp.global_bsz)
+    device_kind = getattr(jax.devices()[0], "device_kind", None)
+    peak_flops = obs_flops.peak_flops_for(device_kind)
+    if telemetry.active_sink() is not None:
+        # per-LayerRun cost-model predictions: the search engine's expected
+        # time/memory per compiled run, recorded up-front so `cli report`
+        # can lay the measured steady state beside them (obs/attribution.py)
+        from galvatron_tpu.obs import attribution as obs_attr
+
+        try:
+            predictions = obs_attr.predict_layer_runs(cfg, hp)
+        except Exception as e:  # analytic tables cannot price this config
+            predictions = None
+            telemetry.emit("log", message="layer-run prediction skipped: %s" % e)
+        for p in predictions or ():
+            telemetry.emit("layer_run", **p)
 
     # ------------------------------------------------------------- resilience
     res = rsl.ResilienceCounters()
@@ -254,6 +307,22 @@ def train(args) -> dict:
         if jax.process_index() == 0:
             print("resumed from %s at iteration %d" % (args.load, start_iter))
 
+    telemetry.emit(
+        "run_start",
+        model="%s_%s" % (args.model_type, args.model_size or fam.default_size),
+        world_size=hp.world_size,
+        strategy=hp.to_json_dict(),
+        train_iters=args.train_iters,
+        global_bsz=hp.global_bsz,
+        start_iter=start_iter,
+        model_flops_per_step=step_flops,
+        peak_flops=peak_flops,
+        device_kind=device_kind,
+        pipeline_type=hp.pipeline_type,
+        num_layers=hp.num_layers,
+        resumed_from=args.load or None,
+    )
+
     step_fn = model.make_train_step(
         tx, guard_anomalies=guard is not None,
         donate=bool(getattr(args, "donate_step", 1)),
@@ -278,6 +347,7 @@ def train(args) -> dict:
                 t1 = time.perf_counter()
                 key = _step_exec_key(model.mesh, lowered)
                 compiled = _STEP_EXECUTABLES.get(key) if key is not None else None
+                memo_hit = compiled is not None
                 if compiled is None:
                     compiled = _compile_uncached(lowered)
                     if key is not None:
@@ -291,6 +361,18 @@ def train(args) -> dict:
                 # process did not run XLA again for this program
                 prof.record_compile(trace_ms=(t1 - t0) * 1e3,
                                     compile_ms=(t2 - t1) * 1e3)
+                try:
+                    prof.compiled_memory_mb = compiled_step_memory_mb(compiled) or None
+                except Exception:
+                    prof.compiled_memory_mb = None
+                telemetry.emit(
+                    "compile",
+                    trace_ms=(t1 - t0) * 1e3,
+                    compile_ms=(t2 - t1) * 1e3,
+                    compiled_memory_mb=prof.compiled_memory_mb,
+                    xla_flops_per_step=obs_flops.xla_flops(compiled),
+                    cache_hit=memo_hit or None,
+                )
                 _aot["fn"] = compiled
             except Exception:
                 _aot["fn"] = step_fn
@@ -406,11 +488,52 @@ def train(args) -> dict:
         rank=jax.process_index(),
         model_name="%s_%s" % (args.model_type, args.model_size or fam.default_size),
         log_dir=getattr(args, "train_log_dir", None),
+        model_flops=step_flops,
+        peak_flops=peak_flops,
     )
 
     preempt = None
     if getattr(args, "emergency_save", 0):
         preempt = rsl.PreemptionHandler().install()
+
+    # ------------------------------------------------------------ XLA trace
+    # opt-in jax.profiler capture (Perfetto/TensorBoard) around a small step
+    # window: started when the window's first step is DISPATCHED, stopped
+    # when its last step has DRAINED (so the captured device timeline
+    # contains the windowed steps' execution, not just their dispatch).
+    # Backends that cannot trace skip gracefully and say so.
+    trace_dir = getattr(args, "xla_trace", None)
+    trace_lo, trace_hi = _parse_trace_steps(getattr(args, "trace_steps", None))
+    trace_state = {"active": False, "done": trace_dir is None}
+
+    def maybe_start_trace(iteration):
+        if trace_state["done"] or trace_state["active"] or iteration < trace_lo:
+            return
+        try:
+            jax.profiler.start_trace(trace_dir)
+            trace_state["active"] = True
+            telemetry.emit("trace", action="start", dir=trace_dir,
+                           first_step=trace_lo, last_step=trace_hi)
+        except Exception as e:
+            trace_state["done"] = True
+            telemetry.emit("trace", action="error", error=str(e))
+            if jax.process_index() == 0:
+                print("xla trace skipped (%s): %s" % (type(e).__name__, e))
+
+    def maybe_stop_trace(iteration=None):
+        if not trace_state["active"]:
+            return
+        if iteration is not None and iteration < trace_hi:
+            return
+        trace_state["active"] = False
+        trace_state["done"] = True
+        try:
+            jax.profiler.stop_trace()
+            telemetry.emit("trace", action="stop", dir=trace_dir)
+        except Exception as e:
+            telemetry.emit("trace", action="error", error=str(e))
+            if jax.process_index() == 0:
+                print("xla trace stop failed (%s): %s" % (type(e).__name__, e))
 
     # every save — periodic, final, rollback re-save AND the emergency save a
     # preemption triggers — carries provenance, so the NEXT resume can
@@ -446,15 +569,49 @@ def train(args) -> dict:
     last_save = None
     it = start_iter
 
+    def emit_step_event(d_it, metrics, loss, disp_ms):
+        """One schema-valid ``step`` event per drained iteration. Costs a
+        device memory-stats read plus one enqueue — only paid when a
+        telemetry sink is installed (the ≤2%% steps/s overhead budget).
+        `disp_ms` travels with the step through the in-flight window —
+        ``prof.dispatch_ms[-1]`` would belong to the latest DISPATCHED
+        iteration, several ahead of the one draining here."""
+        if telemetry.active_sink() is None:
+            return
+        iter_ms = prof.all_times_ms[-1] if prof.all_times_ms else None
+        # host_blocked was appended by prof.end() for THIS iteration iff it
+        # is post-warmup; warmup steps omit the field
+        blocked = prof.host_blocked_ms[-1] \
+            if (d_it >= prof.warmup and prof.host_blocked_ms) else None
+        mem = device_memory_stats()
+        grad_norm = metrics.get("grad_norm") if isinstance(metrics, dict) else None
+        if grad_norm is not None:
+            grad_norm = float(grad_norm)
+        telemetry.emit(
+            "step", iter=d_it,
+            loss=loss if np.isfinite(loss) else None,
+            iter_ms=iter_ms,
+            dispatch_ms=disp_ms,
+            host_blocked_ms=blocked,
+            hbm_in_use_mb=mem["bytes_in_use"] / 2**20 or None,
+            hbm_peak_mb=mem["peak_bytes_in_use"] / 2**20 or None,
+            mfu=obs_flops.mfu(step_flops, iter_ms, peak_flops),
+            model_flops_per_s=obs_flops.flops_per_s(step_flops, iter_ms),
+            grad_norm=grad_norm if grad_norm is None or np.isfinite(grad_norm) else None,
+        )
+
     def drain_one():
         """Drain the oldest in-flight step: block on its metrics and run the
         host-side bookkeeping the synchronous loop did inline (iteration
-        log, anomaly accounting). Returns (iteration, rollback_needed)."""
-        d_it, metrics = inflight.popleft()
+        log, anomaly accounting, telemetry). Returns (iteration,
+        rollback_needed)."""
+        d_it, metrics, disp_ms = inflight.popleft()
         prof.end(d_it, n_samples=hp.global_bsz, outputs=metrics["loss"])
         if args.profile or d_it % max(args.log_interval, 1) == 0:
             prof.log_iteration(d_it, metrics)
         loss = float(metrics["loss"])
+        emit_step_event(d_it, metrics, loss, disp_ms)
+        maybe_stop_trace(d_it)
         verdict = guard.observe(loss) if guard is not None else "ok"
         if verdict == "ok":
             losses.append(loss)
@@ -463,6 +620,10 @@ def train(args) -> dict:
         # the jitted step already kept the old params/opt_state
         # (guard_anomalies select); only account and maybe roll back
         res.anomalies_skipped += 1
+        telemetry.emit(
+            "anomaly_skip", iter=d_it, verdict=verdict,
+            loss=loss if np.isfinite(loss) else None, strikes=guard.strikes,
+        )
         if jax.process_index() == 0:
             print(
                 "iteration %d: %s anomaly (loss %r) — update skipped "
@@ -511,6 +672,10 @@ def train(args) -> dict:
             offset = res.rollbacks * getattr(args, "anomaly_reseed", 0)
             open_stream(it + offset)
             guard.reset_after_rollback()
+            telemetry.emit(
+                "rollback", to_iter=it, at_iter=d_it, count=res.rollbacks,
+                stream_offset=offset,
+            )
             if jax.process_index() == 0:
                 print(
                     "rolled back to checkpoint iteration %d "
@@ -527,6 +692,7 @@ def train(args) -> dict:
                     hooks.on_step(it)
                 if preempt is not None and preempt.triggered:
                     interrupted = preempt.signal_name
+                    telemetry.emit("preemption", signal=interrupted, iter=it)
             if interrupted is not None or it >= args.train_iters:
                 # loop exit: forced full drain first. A rollback surfacing in
                 # the final drain resumes training at the restored iteration
@@ -536,6 +702,7 @@ def train(args) -> dict:
                     continue
                 break
             batch = next_batch()
+            maybe_start_trace(it)
             prof.start(it)
             if guard is not None:
                 # NB deferred metrics: the spike cap is computed from losses
@@ -545,8 +712,8 @@ def train(args) -> dict:
                     params, opt_state, batch, np.float32(guard.spike_cap()))
             else:
                 params, opt_state, metrics = compiled_step(params, opt_state, batch)
-            prof.dispatched(it)
-            inflight.append((it, metrics))
+            disp_ms = prof.dispatched(it)
+            inflight.append((it, metrics, disp_ms))
             it += 1
             if drain_inflight(inflight_window):
                 continue
@@ -555,6 +722,7 @@ def train(args) -> dict:
                     continue
                 vloss = evaluate(params, "valid")
                 valid_losses.append((it, vloss))
+                telemetry.emit("eval", iter=it, split="valid", loss=vloss)
                 if jax.process_index() == 0:
                     print("iteration %d: valid loss %.6f" % (it, vloss))
             if args.save and args.save_interval and it % args.save_interval == 0:
@@ -577,6 +745,8 @@ def train(args) -> dict:
         prof.loop_fence((params, opt_state))
     finally:
         close_stream()
+        maybe_stop_trace()
+        prof.close()
         if preempt is not None:
             preempt.uninstall()
     prof.resilience_counters = res.as_dict()
@@ -588,8 +758,12 @@ def train(args) -> dict:
     if eval_interval:
         summary["valid_losses"] = valid_losses
         summary["test_loss"] = evaluate(params, "test")
+        telemetry.emit("eval", iter=it, split="test", loss=summary["test_loss"])
         if jax.process_index() == 0:
             print("final test loss %.6f" % summary["test_loss"])
+    telemetry.emit("run_end", summary={
+        k: v for k, v in summary.items() if k not in ("losses", "valid_losses")
+    })
     if args.profile and jax.process_index() == 0:
         print({k: v for k, v in summary.items() if k != "losses"})
     return summary

@@ -1,0 +1,22 @@
+"""Runtime observability: structured telemetry, MFU accounting, attribution.
+
+The paper's premise is a closed profile -> search -> train loop; this package
+is the measurement substrate that closes it at runtime:
+
+- ``obs.telemetry``   — a schema-versioned JSONL event stream (per-step and
+  lifecycle events), buffered off the critical path like runtime/prefetch.py.
+- ``obs.flops``       — analytic model-FLOPs accounting + a per-device-kind
+  peak-FLOPs registry, so every timing surface (profiler summary, telemetry,
+  bench sections) can report MFU and model-FLOPs/s.
+- ``obs.attribution`` — the predicted-vs-measured divergence table: the
+  search engine's TimeCostModel/MemoryCostModel prediction per LayerRun next
+  to measured steady-state step time and compiled-step memory.
+- ``obs.report``      — offline analysis of a telemetry JSONL
+  (``python -m galvatron_tpu.cli report``): steady-state detection, MFU,
+  lifecycle timeline, divergence table.
+
+Import-light on purpose: ``telemetry``/``flops``/``report`` are stdlib-only
+at module scope (jax is touched only inside functions that receive jax
+objects), so the offline report path never initialises an accelerator
+backend.
+"""

@@ -1,0 +1,237 @@
+"""Analytic model-FLOPs accounting and the peak-FLOPs registry behind MFU.
+
+Model FLOPs (not hardware FLOPs): the arithmetic the model semantically
+requires — matmul-dominated terms of attention (including the causal 0.5
+factor), the MLP, and the embed/head projection — independent of remat
+replay or compiler fusions, per the PaLM appendix-B convention. MFU is then
+``model_flops / step_time / peak_flops`` on the device kind's peak dense
+matmul throughput.
+
+Two validation hooks keep the analytic numbers honest:
+
+- :func:`xla_flops` reads ``cost_analysis()`` off a lowered/compiled XLA
+  program where the backend reports flops (XLA:CPU does), and
+  tests/obs/test_flops.py pins the analytic forward count against it on a
+  tiny model;
+- every consumer (RuntimeProfiler.summary, per-step telemetry, bench
+  sections) reports model-FLOPs/s alongside MFU, so a wrong peak entry
+  shifts MFU but never the throughput trend.
+
+Import-light on purpose: math/os only at module scope — the bench
+orchestrator (which must never import jax) reads the registry directly; jax
+is touched only inside :func:`xla_flops`, which receives an already-built
+jax object.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+# Peak dense matmul throughput per chip, FLOP/s, by device_kind prefix
+# (jax Device.device_kind). bf16 for the TPU generations; the "cpu" entry is
+# a NOMINAL single-host figure (a few GFLOP/s/core class) so CPU test runs
+# still produce a well-defined MFU — treat absolute CPU MFU as a label, not
+# a measurement. Extend via GALVATRON_PEAK_FLOPS (overrides everything).
+PEAK_FLOPS_BY_KIND: Dict[str, float] = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+    "TPU7x": 2307e12,
+    "cpu": 5e10,
+}
+
+
+def peak_flops_for(device_kind: Optional[str]) -> Optional[float]:
+    """Peak FLOP/s for a device kind (longest-prefix match, case-insensitive);
+    None when unknown. $GALVATRON_PEAK_FLOPS overrides the registry — the
+    escape hatch for new chips and for declaring an honest CPU peak."""
+    override = os.environ.get("GALVATRON_PEAK_FLOPS")
+    if override:
+        try:
+            return float(override)
+        except ValueError:
+            pass
+    if not device_kind:
+        return None
+    kind = device_kind.lower()
+    best: Optional[float] = None
+    best_len = -1
+    for prefix, peak in PEAK_FLOPS_BY_KIND.items():
+        if kind.startswith(prefix.lower()) and len(prefix) > best_len:
+            best, best_len = peak, len(prefix)
+    return best
+
+
+# ------------------------------------------------------------ analytic FLOPs
+def layer_fwd_flops(
+    *,
+    hidden: int,
+    num_heads: int,
+    seq_len: int,
+    ffn_hidden: Optional[int] = None,
+    head_dim: Optional[int] = None,
+    num_kv_heads: Optional[int] = None,
+    causal: bool = True,
+    swiglu: bool = False,
+    tokens: Optional[float] = None,
+) -> float:
+    """Forward model FLOPs of ONE transformer block over `tokens` tokens
+    (default: one sequence). Matmul terms only (2 FLOPs per MAC); norms and
+    elementwise activations are O(tokens*hidden) noise next to these."""
+    tokens = float(seq_len if tokens is None else tokens)
+    ffn = ffn_hidden or 4 * hidden
+    hd = head_dim or hidden // num_heads
+    nkv = num_kv_heads or num_heads
+    q_dim = num_heads * hd
+    # per-token projection matmuls: q, fused kv (GQA-scaled), out
+    proj = 2.0 * hidden * q_dim + 2.0 * hidden * (2 * nkv * hd) + 2.0 * q_dim * hidden
+    # per-token attention arithmetic: scores (q·kᵀ) + weighted sum (p·v),
+    # each 2*S*q_dim; causal masks half the score matrix
+    attn = 2.0 * (2.0 * seq_len * q_dim) * (0.5 if causal else 1.0)
+    # MLP: swiglu projects to 2*ffn (gate+up) then back; gelu/relu ffn both ways
+    mlp = (2.0 * hidden * (2 * ffn) + 2.0 * ffn * hidden) if swiglu \
+        else (2.0 * hidden * ffn + 2.0 * ffn * hidden)
+    return tokens * (proj + attn + mlp)
+
+
+def layer_fwd_flops_from_config(cfg: Any, tokens: Optional[float] = None,
+                                seq_len: Optional[int] = None) -> Optional[float]:
+    """Duck-typed entry for TransformerConfig-shaped configs; None when the
+    config lacks the transformer fields (custom families)."""
+    hidden = getattr(cfg, "hidden_size", None)
+    heads = getattr(cfg, "num_heads", None)
+    seq = seq_len or getattr(cfg, "max_seq_len", None)
+    if not hidden or not heads or not seq:
+        return None
+    return layer_fwd_flops(
+        hidden=hidden,
+        num_heads=heads,
+        seq_len=seq,
+        ffn_hidden=getattr(cfg, "ffn_hidden", None),
+        head_dim=getattr(cfg, "head_dim", None),
+        num_kv_heads=getattr(cfg, "num_kv_heads", None),
+        causal=bool(getattr(cfg, "causal", True)),
+        swiglu=getattr(cfg, "activation", "gelu") == "swiglu",
+        tokens=tokens,
+    )
+
+
+def head_fwd_flops_from_config(cfg: Any, tokens: Optional[float] = None) -> float:
+    """Embed/head projection FLOPs over `tokens` tokens: the vocab matmul for
+    lm/mlm heads (embedding lookups are gathers, ~0 FLOPs), the class
+    projection for classification heads."""
+    hidden = getattr(cfg, "hidden_size", 0) or 0
+    tokens = float(tokens if tokens is not None else getattr(cfg, "max_seq_len", 0) or 0)
+    head_type = getattr(cfg, "head_type", "lm")
+    if head_type in ("lm", "mlm"):
+        vocab = getattr(cfg, "vocab_size", 0) or 0
+        extra = 2.0 * hidden * hidden if head_type == "mlm" else 0.0  # transform dense
+        return tokens * (2.0 * hidden * vocab + extra)
+    if head_type == "classification":
+        classes = getattr(cfg, "num_classes", 0) or 0
+        # one pooled vector per sample; callers pass tokens=batch*seq, the
+        # per-sample projection is seq-fold smaller — negligible, price ~0
+        return 2.0 * hidden * classes
+    return 0.0
+
+
+def model_fwd_flops(cfg: Any, batch_size: int = 1) -> Optional[float]:
+    """Whole-model forward FLOPs for one batch; None for configs the
+    analytic model cannot describe."""
+    seq = getattr(cfg, "max_seq_len", None)
+    layers = getattr(cfg, "num_layers", None)
+    if not seq or not layers:
+        return None
+    tokens = float(batch_size) * seq
+    per_layer = layer_fwd_flops_from_config(cfg, tokens=tokens)
+    if per_layer is None:
+        return None
+    return layers * per_layer + head_fwd_flops_from_config(cfg, tokens=tokens)
+
+
+# backward ~= 2x forward (dL/dx and dL/dW each re-run every matmul)
+BWD_FWD_RATIO = 2.0
+
+
+def train_step_flops(cfg: Any, global_bsz: int) -> Optional[float]:
+    """Model FLOPs of one optimizer step at `global_bsz`: forward + backward
+    (3x forward). Remat replay is deliberately NOT counted — MFU measures
+    useful arithmetic, recompute is overhead it should expose."""
+    fwd = model_fwd_flops(cfg, batch_size=global_bsz)
+    if fwd is None:
+        return None
+    return fwd * (1.0 + BWD_FWD_RATIO)
+
+
+def train_flops_from_params(n_params: float, tokens: float, num_layers: int,
+                            seq_len: int, hidden: int, causal: bool = True) -> float:
+    """The 6*N*T parameter-count convention (+ attention term), for callers
+    that have a live param tree instead of a config (bench.py's layer-stack
+    sections)."""
+    attn = 12.0 * num_layers * seq_len * hidden * tokens * (0.5 if causal else 1.0)
+    return 6.0 * float(n_params) * float(tokens) + attn
+
+
+def run_fwd_flops(cfg: Any, hp: Any) -> Optional[List[float]]:
+    """Per-LayerRun forward FLOPs for one global batch (config/strategy
+    layer_runs partitioning); None when the model is not analytically
+    describable. The head/embed share is appended as a final pseudo-run so
+    shares over the step sum to 1."""
+    from galvatron_tpu.config.strategy import layer_runs
+
+    tokens = float(hp.global_bsz) * (getattr(cfg, "max_seq_len", 0) or 0)
+    per_layer = layer_fwd_flops_from_config(cfg, tokens=tokens)
+    if per_layer is None or not tokens:
+        return None
+    out = [per_layer * run.length for run in layer_runs(hp)]
+    out.append(head_fwd_flops_from_config(cfg, tokens=tokens))
+    return out
+
+
+# ------------------------------------------------------------------ ratios
+def mfu(flops_per_step: Optional[float], step_ms: Optional[float],
+        peak_flops: Optional[float]) -> Optional[float]:
+    """Model-FLOPs utilization; None when any input is unknown/degenerate."""
+    if not flops_per_step or not step_ms or not peak_flops or step_ms <= 0:
+        return None
+    return flops_per_step / (step_ms / 1e3) / peak_flops
+
+
+def flops_per_s(flops_per_step: Optional[float], step_ms: Optional[float]) -> Optional[float]:
+    if not flops_per_step or not step_ms or step_ms <= 0:
+        return None
+    return flops_per_step / (step_ms / 1e3)
+
+
+def xla_flops(lowered_or_compiled: Any) -> Optional[float]:
+    """Total flops XLA's cost analysis reports for a lowered/compiled
+    program; None when the backend does not report (TPU plugins vary) or the
+    API shape differs. The validation hook for the analytic numbers.
+
+    Caveat (pinned by tests/obs/test_flops.py): HloCostAnalysis counts a
+    while/scan BODY once, not per trip — under scan-over-layer-runs the
+    reported number covers one layer per run, so it under-reports a deep
+    scanned model by roughly the run length. Compare against unrolled
+    programs (or per-run bodies), and treat the recorded
+    ``xla_flops_per_step`` as a lower bound."""
+    try:
+        analysis = lowered_or_compiled.cost_analysis()
+    except Exception:
+        return None
+    # jax has returned both a dict and a per-device list of dicts here
+    if isinstance(analysis, (list, tuple)):
+        analysis = analysis[0] if analysis else None
+    if not isinstance(analysis, dict):
+        return None
+    val = analysis.get("flops")
+    try:
+        val = float(val)
+    except (TypeError, ValueError):
+        return None
+    # XLA reports -1/0 when it cannot count
+    return val if val > 0 else None

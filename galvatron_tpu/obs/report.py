@@ -1,0 +1,254 @@
+"""Offline analysis of a telemetry JSONL: ``python -m galvatron_tpu.cli report``.
+
+Consumes the event stream obs/telemetry.py wrote during training and
+produces the numbers the perf loop runs on:
+
+- **steady-state detection** — the first rolling window of per-step times
+  whose relative stdev drops under a tolerance marks the end of warmup/
+  compile/cache-population noise; the steady step time is the median from
+  there on (falling back to the post-25% median when the run never
+  settles, and saying so).
+- **MFU / model-FLOPs-per-s** — recomputed from the run's recorded
+  ``model_flops_per_step`` + ``peak_flops`` constants at the steady step
+  time (not averaged from per-step MFU, which under the dispatch-ahead
+  loop measures overlapping dispatch->drain latencies).
+- **lifecycle timeline** — anomalies, rollbacks, checkpoint save/restore/GC,
+  retries, preemption, elastic decisions, trace captures, in emit order.
+- **divergence table** — the per-LayerRun predicted-vs-measured join
+  (obs/attribution.py) using the steady step time and the compiled-step
+  memory recorded by the ``compile`` event.
+
+Exit-code contract (shared with the GLS/GLC lint framework): 0 = analyzed
+clean, 1 = schema violations in the stream, 2 = usage/IO failure.
+``--json`` prints the machine-readable analysis dict.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from galvatron_tpu.obs import attribution as A
+from galvatron_tpu.obs import flops as F
+from galvatron_tpu.obs import telemetry as T
+
+# lifecycle event types surfaced on the timeline, in schema order
+TIMELINE_TYPES = (
+    "compile", "checkpoint_save", "checkpoint_restore", "checkpoint_gc",
+    "anomaly_skip", "rollback", "retry", "preemption", "elastic", "trace",
+    "eval",
+)
+
+
+# ---------------------------------------------------------- steady state
+def detect_steady_state(
+    values: Sequence[float], window: int = 5, rel_std: float = 0.15
+) -> Tuple[Optional[int], str]:
+    """(start index, method) of the steady-state region of a per-step time
+    series: the first index where the next `window` values have
+    stdev/mean <= rel_std. Falls back to the post-25% tail when the series
+    never settles ("fallback"), None when there is nothing to measure."""
+    vals = [float(v) for v in values if v is not None]
+    if not vals:
+        return None, "empty"
+    if len(vals) >= max(window, 2):
+        for i in range(0, len(vals) - window + 1):
+            win = vals[i:i + window]
+            mean = statistics.fmean(win)
+            if mean <= 0:
+                continue
+            if statistics.pstdev(win) / mean <= rel_std:
+                return i, "rolling-window"
+    return min(len(vals) - 1, len(vals) // 4), "fallback"
+
+
+def _median(vals: Sequence[float]) -> Optional[float]:
+    vals = [v for v in vals if v is not None]
+    return float(statistics.median(vals)) if vals else None
+
+
+# -------------------------------------------------------------- analysis
+def analyze(
+    events: List[Dict[str, Any]],
+    window: int = 5,
+    rel_std: float = 0.15,
+) -> Dict[str, Any]:
+    """The full analysis dict (the --json payload)."""
+    by_type: Dict[str, List[Dict[str, Any]]] = {}
+    for e in events:
+        by_type.setdefault(e["type"], []).append(e)
+
+    run_start = (by_type.get("run_start") or [{}])[-1]
+    steps = by_type.get("step", [])
+    iter_ms = [e.get("iter_ms") for e in steps if e.get("iter_ms") is not None]
+
+    start_idx, method = detect_steady_state(iter_ms, window=window, rel_std=rel_std)
+    steady: Dict[str, Any] = {"method": method, "window": window, "rel_std": rel_std}
+    if start_idx is not None and iter_ms:
+        tail = iter_ms[start_idx:]
+        steady_ms = _median(tail)
+        steady.update(
+            start_step_index=start_idx,
+            start_iter=steps[start_idx].get("iter") if start_idx < len(steps) else None,
+            step_ms=steady_ms,
+            steps_measured=len(tail),
+        )
+        if steady_ms:
+            steady["steps_per_s"] = 1e3 / steady_ms
+            fps = run_start.get("model_flops_per_step")
+            steady["model_flops_per_s"] = F.flops_per_s(fps, steady_ms)
+            steady["mfu"] = F.mfu(fps, steady_ms, run_start.get("peak_flops"))
+
+    compile_ev = (by_type.get("compile") or [{}])[-1]
+    predictions = [e for e in by_type.get("layer_run", [])]
+    divergence = A.divergence_rows(
+        predictions,
+        measured_step_ms=steady.get("step_ms"),
+        measured_memory_mb=compile_ev.get("compiled_memory_mb"),
+    ) if predictions else []
+
+    timeline = [
+        {k: v for k, v in e.items() if k not in ("v",)}
+        for e in sorted(
+            (e for t in TIMELINE_TYPES for e in by_type.get(t, [])),
+            key=lambda e: e["seq"],
+        )
+    ]
+
+    losses = [e.get("loss") for e in steps if e.get("loss") is not None]
+    analysis: Dict[str, Any] = {
+        "version": T.SCHEMA_VERSION,
+        "run": {k: v for k, v in run_start.items()
+                if k not in ("v", "t", "seq", "type")},
+        "counts": {t: len(v) for t, v in sorted(by_type.items())},
+        "steps": {
+            "n": len(steps),
+            "first_iter": steps[0].get("iter") if steps else None,
+            "last_iter": steps[-1].get("iter") if steps else None,
+            "first_loss": losses[0] if losses else None,
+            "last_loss": losses[-1] if losses else None,
+            "median_iter_ms": _median(iter_ms),
+            "median_dispatch_ms": _median([e.get("dispatch_ms") for e in steps]),
+            "median_host_blocked_ms": _median(
+                [e.get("host_blocked_ms") for e in steps]),
+        },
+        "steady": steady,
+        "compile": {k: v for k, v in compile_ev.items()
+                    if k not in ("v", "t", "seq", "type")},
+        "anomalies": {
+            "skipped": len(by_type.get("anomaly_skip", [])),
+            "rollbacks": len(by_type.get("rollback", [])),
+            "retries": len(by_type.get("retry", [])),
+        },
+        "divergence": divergence,
+        "timeline": timeline,
+    }
+    run_end = by_type.get("run_end")
+    if run_end and run_end[-1].get("summary") is not None:
+        analysis["summary"] = run_end[-1]["summary"]
+    return analysis
+
+
+# ------------------------------------------------------------- rendering
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return "%.4g" % v
+    return str(v)
+
+
+def render(analysis: Dict[str, Any]) -> str:
+    run = analysis["run"]
+    steps = analysis["steps"]
+    steady = analysis["steady"]
+    lines = []
+    lines.append("telemetry report (schema v%d)" % analysis["version"])
+    if run:
+        lines.append(
+            "run: model=%s world=%s bsz=%s iters=%s device=%s"
+            % (run.get("model", "?"), run.get("world_size", "?"),
+               run.get("global_bsz", "?"), run.get("train_iters", "?"),
+               run.get("device_kind", "?"))
+        )
+    lines.append(
+        "steps: %d recorded (iter %s..%s), loss %s -> %s"
+        % (steps["n"], _fmt(steps["first_iter"]), _fmt(steps["last_iter"]),
+           _fmt(steps["first_loss"]), _fmt(steps["last_loss"]))
+    )
+    lines.append(
+        "steady state (%s): step %s ms over %s steps from iter %s "
+        "| steps/s %s | model FLOP/s %s | MFU %s"
+        % (steady.get("method"), _fmt(steady.get("step_ms")),
+           _fmt(steady.get("steps_measured")), _fmt(steady.get("start_iter")),
+           _fmt(steady.get("steps_per_s")), _fmt(steady.get("model_flops_per_s")),
+           _fmt(steady.get("mfu")))
+    )
+    comp = analysis["compile"]
+    if comp:
+        lines.append(
+            "compile: trace %s ms, compile %s ms, compiled memory %s MB, "
+            "xla flops %s"
+            % (_fmt(comp.get("trace_ms")), _fmt(comp.get("compile_ms")),
+               _fmt(comp.get("compiled_memory_mb")),
+               _fmt(comp.get("xla_flops_per_step")))
+        )
+    an = analysis["anomalies"]
+    lines.append(
+        "resilience: %d anomalies skipped, %d rollbacks, %d retries"
+        % (an["skipped"], an["rollbacks"], an["retries"])
+    )
+    lines.append("")
+    lines.append("predicted vs measured per layer run:")
+    lines.append(A.render_divergence_table(analysis["divergence"]))
+    if analysis["timeline"]:
+        lines.append("")
+        lines.append("lifecycle timeline:")
+        for e in analysis["timeline"]:
+            detail = " ".join(
+                "%s=%s" % (k, _fmt(v)) for k, v in e.items()
+                if k not in ("t", "seq", "type")
+            )
+            lines.append("  [seq %4d] %-18s %s" % (e["seq"], e["type"], detail))
+    return "\n".join(lines)
+
+
+# ------------------------------------------------------------------- CLI
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        "galvatron_tpu-report",
+        description="analyze a telemetry JSONL written by train --telemetry",
+        allow_abbrev=False,
+    )
+    p.add_argument("path", help="telemetry .jsonl file")
+    p.add_argument("--json", dest="as_json", action="store_true",
+                   help="machine-readable analysis output")
+    p.add_argument("--steady_window", type=int, default=5,
+                   help="rolling-window length for steady-state detection")
+    p.add_argument("--steady_tol", type=float, default=0.15,
+                   help="relative stdev threshold for the steady window")
+    return p
+
+
+def run(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        events, errors = T.read_events(args.path, strict=False)
+    except OSError as e:
+        print("cannot read %s: %s" % (args.path, e), file=sys.stderr)  # galv-lint: ignore[GLC006] -- CLI usage error
+        return 2
+    for err in errors:
+        print("schema: %s: %s" % (args.path, err), file=sys.stderr)  # galv-lint: ignore[GLC006] -- CLI diagnostics
+    analysis = analyze(events, window=args.steady_window, rel_std=args.steady_tol)
+    analysis["schema_errors"] = errors
+    print(json.dumps(analysis, indent=2) if args.as_json else render(analysis))  # galv-lint: ignore[GLC006] -- CLI output
+    return 1 if errors else 0
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    rc = run(argv)
+    if rc:
+        sys.exit(rc)

@@ -80,6 +80,7 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 from galvatron_tpu.config.strategy import HybridParallelConfig
+from galvatron_tpu.obs import telemetry
 from galvatron_tpu.utils.jsonio import read_json_config, write_json_config
 
 MANIFEST_DIRNAME = "manifests"
@@ -209,6 +210,7 @@ def save_checkpoint(
     """Write params (+ optimizer state + scalar train metadata) at `iteration`,
     commit the integrity manifest (carrying `provenance` when given — see
     runtime/elastic.build_provenance), then GC to the newest `keep_latest_k`."""
+    t0 = time.perf_counter()
     os.makedirs(ckpt_dir, exist_ok=True)
     if hp is not None:
         write_json_config(hp.to_json_dict(), os.path.join(ckpt_dir, "hybrid_parallel_config.json"))
@@ -236,6 +238,11 @@ def save_checkpoint(
         _before_manifest_write(iteration)
     if jax.process_index() == 0:
         _write_manifest(ckpt_dir, iteration, digests, provenance=provenance)
+    telemetry.emit(
+        "checkpoint_save", iteration=iteration, path=ckpt_dir,
+        duration_ms=(time.perf_counter() - t0) * 1e3,
+        emergency=True if (train_meta and train_meta.get("emergency")) else None,
+    )
     if keep_latest_k:
         gc_checkpoints(ckpt_dir, keep_latest_k)
 
@@ -269,7 +276,8 @@ def gc_checkpoints(ckpt_dir: str, keep_latest_k: int,
             except (OSError, ValueError) as e:
                 # a concurrently-removed or stray step is not worth failing
                 # a SAVE over; leave it for the next GC pass
-                print("checkpoint gc: could not delete step %d: %s" % (step, e))
+                telemetry.runtime_log(
+                    "checkpoint gc: could not delete step %d: %s" % (step, e))
                 continue
             deleted.append(step)
     for step in deleted:
@@ -277,6 +285,8 @@ def gc_checkpoints(ckpt_dir: str, keep_latest_k: int,
             os.remove(_manifest_path(ckpt_dir, step))
         except OSError:
             pass
+    if deleted:
+        telemetry.emit("checkpoint_gc", deleted=deleted, path=ckpt_dir)
     return deleted
 
 
@@ -411,7 +421,7 @@ def _verify_items(manifest: Dict[str, Any], restored: Dict[str, Any]) -> Optiona
             return "item %r: leaf count %s != manifest %s" % (
                 name, got["num_leaves"], rec.get("num_leaves"))
         if rec.get("spec_digest") != got["spec_digest"]:
-            print(
+            telemetry.runtime_log(
                 "checkpoint: item %r restored under a different dtype/shape "
                 "spec; skipping value verification" % name
             )
@@ -472,6 +482,8 @@ def load_checkpoint(
     fails verification raises instead — the caller asked for that exact
     state."""
     from galvatron_tpu.analysis import diagnostics as D
+
+    t0 = time.perf_counter()
 
     if hp is not None:
         cfg_path = os.path.join(ckpt_dir, "hybrid_parallel_config.json")
@@ -633,7 +645,7 @@ def load_checkpoint(
                 % (ckpt_dir, {k: v for k, v in sorted(torn.items())})
             )
     if torn:
-        print(
+        telemetry.runtime_log(
             "checkpoint: fell back to intact step %d; skipped torn steps %s"
             % (iteration, sorted(torn))
         )
@@ -664,4 +676,10 @@ def load_checkpoint(
     meta.setdefault("iteration", iteration)
     if torn:
         meta["torn_iterations"] = sorted(torn)
+    telemetry.emit(
+        "checkpoint_restore", iteration=int(meta["iteration"]), path=ckpt_dir,
+        duration_ms=(time.perf_counter() - t0) * 1e3,
+        torn_skipped=len(torn) or None,
+        cross_strategy=True if (target is not None and cross) else None,
+    )
     return params, opt_state, meta

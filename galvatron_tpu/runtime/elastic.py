@@ -42,6 +42,7 @@ from typing import Any, Dict, Optional, Tuple
 
 from galvatron_tpu.analysis import diagnostics as D
 from galvatron_tpu.config.strategy import HybridParallelConfig
+from galvatron_tpu.obs import telemetry
 
 DEFAULT_MEMORY_GB = 16.0  # matches the search CLI's --memory_constraint default
 
@@ -337,7 +338,7 @@ def resolve_resume_strategy(
         )])
     if opt_args is not None and prov.get("optimizer", {}).get("digest"):
         if prov["optimizer"]["digest"] != optimizer_digest(opt_args):
-            print(
+            telemetry.runtime_log(
                 "elastic: optimizer hyperparams differ from the checkpoint's "
                 "(%s); continuing — the structural guard still applies"
                 % prov["optimizer"].get("kind", "?")
@@ -357,6 +358,9 @@ def resolve_resume_strategy(
         # nothing changed: resume under the saved strategy, bitwise identical
         # to a plain --load (the checkpoint's strategy wins over GLOBAL flags
         # so a stale launch script cannot silently fork the trajectory)
+        telemetry.emit(
+            "elastic", action="match", saved_world=saved_world,
+            live_world=live_world)
         return ElasticPlan("match", saved_hp, saved_hp, prov, it)
 
     strategy_file = getattr(args, "elastic_strategy", None)
@@ -364,7 +368,7 @@ def resolve_resume_strategy(
         hp = HybridParallelConfig.from_json(
             strategy_file, world_size=live_world, **exec_kw)
         if hp.global_bsz != saved_hp.global_bsz:
-            print(
+            telemetry.runtime_log(
                 "elastic: --elastic_strategy changes global_bsz %d -> %d; "
                 "the loss trajectory will not be comparable to the original "
                 "run" % (saved_hp.global_bsz, hp.global_bsz)
@@ -405,4 +409,6 @@ def resolve_resume_strategy(
         refusal = _budget_refusal(hp, model_cfg, budget)
         if refusal is not None:
             raise D.DiagnosticError([refusal])
+    telemetry.emit(
+        "elastic", action=action, saved_world=saved_world, live_world=live_world)
     return ElasticPlan(action, hp, saved_hp, prov, it)

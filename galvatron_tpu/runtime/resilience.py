@@ -83,10 +83,15 @@ def with_retry(
     counters: Optional[ResilienceCounters] = None,
     description: str = "operation",
     sleep: Callable[[float], None] = time.sleep,
+    log_fn: Callable[[str], None] = print,
 ):
     """Run `fn()`; on a retryable exception, back off exponentially and retry
     up to `policy.retries` times. Non-retryable exceptions propagate
-    immediately; the last retryable one propagates after the budget."""
+    immediately; the last retryable one propagates after the budget. Each
+    backoff is logged through `log_fn` and recorded as a ``retry`` telemetry
+    event when a sink is active."""
+    from galvatron_tpu.obs import telemetry
+
     policy = policy or RetryPolicy()
     attempt = 0
     while True:
@@ -98,9 +103,13 @@ def with_retry(
             delay = min(policy.base_delay_s * policy.multiplier**attempt, policy.max_delay_s)
             if counters is not None:
                 counters.retries += 1
-            print(
+            log_fn(
                 "resilience: %s failed (%s: %s); retry %d/%d in %.2fs"
                 % (description, type(e).__name__, e, attempt + 1, policy.retries, delay)
+            )
+            telemetry.emit(
+                "retry", description=description, attempt=attempt + 1,
+                error="%s: %s" % (type(e).__name__, e), delay_s=delay,
             )
             sleep(delay)
             attempt += 1

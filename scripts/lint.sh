@@ -13,6 +13,12 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+# Telemetry schema gate: the report CLI must analyze the golden event stream
+# cleanly (exit-code contract shared with the GLS/GLC framework: 0 clean,
+# 1 schema violations, 2 usage/IO). --json keeps the output machine-checked.
+env JAX_PLATFORMS=cpu python -m galvatron_tpu.cli report --json \
+    tests/obs/fixtures/golden_telemetry.jsonl > /dev/null
+
 exec env JAX_PLATFORMS=cpu python -m galvatron_tpu.cli lint \
     --code \
     --world_size 8 \

@@ -1,0 +1,13 @@
+"""GLC006 bad fixture: ad-hoc logging in runtime library code (linted under
+a galvatron_tpu/runtime/ filename — the rule is path-scoped)."""
+
+
+def save_step(path, iteration):
+    print("saving step %d" % iteration)  # GLC006: bare print in library code
+    with open(path, "a") as f:  # GLC006: per-call append-open logging
+        f.write("%d\n" % iteration)
+
+
+def gc_steps(steps):
+    for s in steps:
+        print("deleting", s)  # GLC006

@@ -1,0 +1,33 @@
+"""GLC006 good fixture: the sanctioned runtime-logging paths — telemetry
+events, runtime_log, injectable print_fn/log_fn, one held handle, and the
+pragma escape hatch."""
+
+from galvatron_tpu.obs import telemetry
+
+
+def save_step(path, iteration, log_fn=print):
+    log_fn("saving step %d" % iteration)  # injected logger, not a bare print
+    telemetry.emit("checkpoint_save", iteration=iteration, path=path)
+
+
+def gc_steps(steps):
+    for s in steps:
+        telemetry.runtime_log("deleting step %d" % s)
+
+
+class StepLog:
+    def __init__(self, path):
+        # ONE appending handle held for the run (closed by close()), not a
+        # reopen per call; reads/writes in other modes are out of scope
+        self._fh = open(path, "a")  # galv-lint: ignore[GLC006] -- single held handle
+
+    def write(self, iteration):
+        self._fh.write("%d\n" % iteration)
+
+    def close(self):
+        self._fh.close()
+
+
+def read_manifest(path):
+    with open(path) as f:  # read mode: not logging, not flagged
+        return f.read()

@@ -12,11 +12,14 @@ FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "code")
 
 def lint_fixture(name, **kw):
     path = os.path.join(FIXTURES, name)
+    # GLC006 is path-scoped to the runtime/obs library dirs: lint its
+    # fixtures under a synthetic in-scope filename
+    filename = ("galvatron_tpu/runtime/%s" % name) if name.startswith("glc006") else path
     with open(path, "r", encoding="utf-8") as fp:
-        return C.lint_source(fp.read(), filename=path, **kw)
+        return C.lint_source(fp.read(), filename=filename, **kw)
 
 
-RULES = ("GLC001", "GLC002", "GLC003", "GLC004", "GLC005")
+RULES = ("GLC001", "GLC002", "GLC003", "GLC004", "GLC005", "GLC006")
 
 
 @pytest.mark.parametrize("code", RULES)
@@ -132,3 +135,28 @@ def test_iter_python_files_skips_pycache(tmp_path):
     (pc / "a.cpython-310.py").write_text("x = 1\n")
     files = C.iter_python_files([str(tmp_path)])
     assert [os.path.basename(f) for f in files] == ["a.py"]
+
+
+def test_glc006_is_path_scoped():
+    """The same bad source linted OUTSIDE galvatron_tpu/{runtime,obs}/ is
+    clean: CLI drivers and tests may print."""
+    path = os.path.join(FIXTURES, "glc006_bad.py")
+    with open(path, "r", encoding="utf-8") as fp:
+        src = fp.read()
+    assert C.lint_source(src, filename=path) == []
+    assert {d.code for d in C.lint_source(
+        src, filename="galvatron_tpu/obs/glc006_bad.py")} == {"GLC006"}
+
+
+def test_glc006_pragma_suppression():
+    ds = lint_fixture("glc006_bad.py")
+    flagged_open = [d for d in ds if d.key == "open"]
+    assert flagged_open, [d.format() for d in ds]
+    path = os.path.join(FIXTURES, "glc006_bad.py")
+    with open(path, "r", encoding="utf-8") as fp:
+        src = fp.read().replace(
+            "# GLC006: per-call append-open logging",
+            "# galv-lint: ignore[GLC006]")
+    ds2 = C.lint_source(src, filename="galvatron_tpu/runtime/glc006_bad.py")
+    assert not [d for d in ds2 if d.key == "open"], [d.format() for d in ds2]
+    assert [d for d in ds2 if d.key == "print"]  # other findings survive

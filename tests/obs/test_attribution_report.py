@@ -1,0 +1,125 @@
+"""Attribution (predicted-vs-measured per LayerRun) and the offline report
+CLI over the golden telemetry fixture."""
+
+import json
+import os
+
+import jax.numpy as jnp
+import pytest
+
+from galvatron_tpu.config.strategy import HybridParallelConfig, LayerStrategy, layer_runs
+from galvatron_tpu.models import base as M
+from galvatron_tpu.obs import attribution as A
+from galvatron_tpu.obs import report as R
+from galvatron_tpu.obs import telemetry as T
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "fixtures", "golden_telemetry.jsonl")
+
+
+def tiny_cfg(num_layers=4):
+    return M.TransformerConfig(
+        hidden_size=64, num_heads=4, num_layers=num_layers, vocab_size=128,
+        max_seq_len=32, compute_dtype=jnp.float32, param_dtype=jnp.float32)
+
+
+def hetero_hp():
+    """Two distinct layer runs: layers 0-1 tp=2, layers 2-3 tp=1."""
+    layers = [LayerStrategy(tp=2)] * 2 + [LayerStrategy(tp=1, checkpoint=1)] * 2
+    return HybridParallelConfig(world_size=8, pp=1, layers=layers, global_bsz=8)
+
+
+def test_predict_layer_runs_covers_every_run():
+    cfg, hp = tiny_cfg(), hetero_hp()
+    runs = layer_runs(hp)
+    assert len(runs) == 2
+    preds = A.predict_layer_runs(cfg, hp)
+    assert preds is not None
+    layer_rows = [p for p in preds if p["run"] != A.HEAD_RUN]
+    assert [(p["start"], p["stop"]) for p in layer_rows] == [(0, 2), (2, 4)]
+    for p in layer_rows:
+        assert p["predicted_ms"] > 0 and p["predicted_memory_mb"] > 0
+        assert 0 < p["flops_share"] < 1
+    # every prediction is a schema-valid layer_run event
+    sink = T.MemorySink()
+    for p in preds:
+        sink.emit("layer_run", **p)
+    # shares (incl. the head pseudo-run) cover the whole step
+    assert sum(p["flops_share"] for p in preds) == pytest.approx(1.0, abs=1e-3)
+
+
+def test_divergence_rows_split_measured_step_by_share():
+    cfg, hp = tiny_cfg(), hetero_hp()
+    preds = A.predict_layer_runs(cfg, hp)
+    rows = A.divergence_rows(preds, measured_step_ms=100.0, measured_memory_mb=500.0)
+    measured = [r["measured_ms"] for r in rows]
+    assert sum(measured) == pytest.approx(100.0, rel=1e-3)
+    for r in rows:
+        if r.get("predicted_ms"):
+            assert r["time_ratio"] == pytest.approx(
+                r["predicted_ms"] / r["measured_ms"], rel=1e-3)
+    table = A.render_divergence_table(rows)
+    assert "pred_ms" in table and "head" in table
+
+
+def test_report_analyze_golden_steady_state_and_divergence():
+    events, errors = T.read_events(GOLDEN)
+    assert errors == []
+    analysis = R.analyze(events)
+    steady = analysis["steady"]
+    # the golden stream settles at ~100ms after 2-3 warmup steps
+    assert steady["method"] == "rolling-window"
+    assert steady["step_ms"] == pytest.approx(100.0, rel=0.05)
+    assert steady["start_iter"] <= 3
+    assert steady["mfu"] == pytest.approx(
+        1.6e9 / (steady["step_ms"] / 1e3) / 5e10, rel=1e-6)
+    # divergence table joins the recorded predictions with the measured step
+    rows = analysis["divergence"]
+    assert len(rows) == 3
+    assert sum(r["measured_ms"] for r in rows) == pytest.approx(
+        steady["step_ms"], rel=1e-3)
+    # memory joins against the compile event's working set
+    assert rows[0]["measured_memory_mb"] == pytest.approx(120.5 * 0.225, rel=1e-3)
+    # lifecycle timeline carries the anomaly/rollback/save/restore story
+    types = [e["type"] for e in analysis["timeline"]]
+    for t in ("anomaly_skip", "rollback", "checkpoint_save",
+              "checkpoint_restore", "checkpoint_gc", "retry", "trace"):
+        assert t in types, types
+    assert analysis["anomalies"] == {"skipped": 1, "rollbacks": 1, "retries": 1}
+
+
+def test_steady_state_detection_edges():
+    assert R.detect_steady_state([]) == (None, "empty")
+    # monotone noise never settles -> fallback tail
+    idx, method = R.detect_steady_state([100, 200, 50, 300, 20, 400], window=3,
+                                        rel_std=0.01)
+    assert method == "fallback" and idx is not None
+    # flat series settles immediately
+    idx, method = R.detect_steady_state([10.0] * 8, window=4)
+    assert (idx, method) == (0, "rolling-window")
+
+
+def test_report_cli_golden_json_exit_zero(capsys):
+    rc = R.run([GOLDEN, "--json"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    doc = json.loads(out)
+    assert doc["schema_errors"] == []
+    assert doc["steady"]["step_ms"] > 0
+    assert doc["run"]["model"] == "llama_tiny"
+    assert len(doc["divergence"]) == 3
+
+
+def test_report_cli_schema_violation_exits_one(tmp_path, capsys):
+    bad = tmp_path / "bad.jsonl"
+    lines = open(GOLDEN).read().splitlines()
+    evil = json.loads(lines[0])
+    evil["smuggled_key"] = 1
+    bad.write_text("\n".join(lines[:3] + [json.dumps(evil)]) + "\n")
+    rc = R.run([str(bad)])
+    err = capsys.readouterr().err
+    assert rc == 1
+    assert "unknown key" in err
+
+
+def test_report_cli_missing_file_exits_two(tmp_path, capsys):
+    assert R.run([str(tmp_path / "nope.jsonl")]) == 2

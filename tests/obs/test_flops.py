@@ -1,0 +1,117 @@
+"""FLOPs accounting: the analytic counts agree with XLA's own cost analysis
+on a tiny model, and MFU plumbs into the profiler summary."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from galvatron_tpu.models import base as M
+from galvatron_tpu.obs import flops as F
+from galvatron_tpu.profiler.runtime import RuntimeProfiler
+
+TINY = dict(hidden_size=64, num_heads=4, num_layers=2, vocab_size=128,
+            max_seq_len=32, compute_dtype=jnp.float32, param_dtype=jnp.float32)
+
+
+def tiny_cfg(**kw):
+    d = dict(TINY)
+    d.update(kw)
+    return M.TransformerConfig(**d)
+
+
+def test_peak_registry_prefix_match_and_override(monkeypatch):
+    assert F.peak_flops_for("TPU v5 lite") == 197e12
+    assert F.peak_flops_for("TPU v5p chip") == 459e12  # longest prefix wins
+    assert F.peak_flops_for("cpu") == F.PEAK_FLOPS_BY_KIND["cpu"]
+    assert F.peak_flops_for("quantum-npu-9000") is None
+    assert F.peak_flops_for(None) is None
+    monkeypatch.setenv("GALVATRON_PEAK_FLOPS", "123e9")
+    assert F.peak_flops_for("anything") == 123e9
+
+
+def test_layer_flops_scaling_laws():
+    base = F.layer_fwd_flops(hidden=64, num_heads=4, seq_len=32)
+    # doubling tokens doubles flops; non-causal attention costs more
+    assert F.layer_fwd_flops(hidden=64, num_heads=4, seq_len=32, tokens=64) \
+        == pytest.approx(2 * base)
+    assert F.layer_fwd_flops(hidden=64, num_heads=4, seq_len=32, causal=False) > base
+    # swiglu at same ffn costs one extra ffn matmul
+    gelu = F.layer_fwd_flops(hidden=64, num_heads=4, seq_len=32, ffn_hidden=256)
+    swiglu = F.layer_fwd_flops(hidden=64, num_heads=4, seq_len=32, ffn_hidden=256,
+                               swiglu=True)
+    assert swiglu == pytest.approx(gelu + 32 * 2 * 64 * 256)
+
+
+def test_train_step_flops_is_3x_forward():
+    cfg = tiny_cfg()
+    assert F.train_step_flops(cfg, 8) == pytest.approx(3 * F.model_fwd_flops(cfg, 8))
+
+
+def test_analytic_forward_flops_match_xla_cost_analysis():
+    """The acceptance check behind every MFU number: the analytic forward
+    count agrees with what XLA says the lowered forward actually computes
+    (XLA:CPU reports flops; it also counts the softmax/norm elementwise work
+    the analytic matmul-only model ignores, hence the one-sided band).
+    num_layers=1 keeps the stack unrolled: HloCostAnalysis counts a scan
+    body ONCE regardless of trip count (see obs.flops.xla_flops), so a
+    scanned stack would under-report by the run length."""
+    cfg = tiny_cfg(num_layers=1)
+    batch = 4
+    params = M.init_model_params(jax.random.PRNGKey(0), cfg)
+    tokens = jnp.zeros((batch, cfg.max_seq_len), jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(cfg.max_seq_len), tokens.shape)
+
+    def fwd(p, t):
+        return M.model_forward(p, t, positions, cfg)
+
+    compiled = jax.jit(fwd).lower(params, tokens).compile()
+    reported = F.xla_flops(compiled)
+    if reported is None:
+        pytest.skip("backend reports no flops in cost_analysis")
+    analytic = F.model_fwd_flops(cfg, batch)
+    # analytic counts the matmuls only: it must cover >=60% of XLA's count
+    # and never exceed it by more than 25% (constant-folding slack)
+    assert 0.6 * reported <= analytic <= 1.25 * reported, (analytic, reported)
+
+
+def test_mfu_plumbs_into_profiler_summary():
+    prof = RuntimeProfiler(warmup=0, model_flops=1e9, peak_flops=1e12)
+    prof.start(0)
+    prof._t0s[0] -= 0.1  # fake a 100ms step without sleeping
+    prof.end(0, n_samples=8)
+    s = prof.summary()
+    assert s["model_flops_per_step"] == 1e9
+    assert s["model_flops_per_s"] == pytest.approx(1e10, rel=0.2)
+    assert s["mfu"] == pytest.approx(0.01, rel=0.2)
+
+
+def test_summary_omits_mfu_without_flops():
+    prof = RuntimeProfiler(warmup=0)
+    prof.start(0)
+    prof.end(0, n_samples=8)
+    s = prof.summary()
+    assert "mfu" not in s and "model_flops_per_s" not in s
+
+
+def test_run_fwd_flops_shares_sum_to_one():
+    from galvatron_tpu.config.strategy import HybridParallelConfig
+
+    cfg = tiny_cfg()
+    hp = HybridParallelConfig.uniform(world_size=8, num_layers=2, tp=2, global_bsz=8)
+    runs = F.run_fwd_flops(cfg, hp)
+    assert runs is not None and len(runs) == 2  # one scanned run + head
+    total = sum(runs)
+    assert total == pytest.approx(F.model_fwd_flops(cfg, 8))
+
+
+def test_xla_flops_handles_unreportable_objects():
+    class NoAnalysis:
+        def cost_analysis(self):
+            raise RuntimeError("nope")
+
+    class WeirdShape:
+        def cost_analysis(self):
+            return [{"flops": -1.0}]
+
+    assert F.xla_flops(NoAnalysis()) is None
+    assert F.xla_flops(WeirdShape()) is None

@@ -1,0 +1,159 @@
+"""TelemetrySink contract: ordering, flush-on-close, exception propagation,
+schema round-trip, unknown-key rejection, and the process-wide active-sink
+routing the runtime layers emit through."""
+
+import json
+import os
+import threading
+
+import pytest
+
+from galvatron_tpu.obs import telemetry as T
+
+
+def test_memory_sink_orders_and_stamps_envelope():
+    s = T.MemorySink()
+    for i in range(5):
+        s.emit("step", iter=i, loss=1.0, iter_ms=2.0)
+    assert [e["seq"] for e in s.events] == list(range(5))
+    assert [e["iter"] for e in s.events] == list(range(5))
+    assert all(e["v"] == T.SCHEMA_VERSION and e["t"] > 0 for e in s.events)
+
+
+def test_unknown_event_type_and_unknown_key_rejected():
+    s = T.MemorySink()
+    with pytest.raises(T.TelemetryError, match="unknown telemetry event type"):
+        s.emit("bogus_type", x=1)
+    with pytest.raises(T.TelemetryError, match="unknown key"):
+        s.emit("step", iter=1, bogus_key=1)
+    with pytest.raises(T.TelemetryError, match="missing required"):
+        s.emit("eval", iter=1, split="valid")  # loss required
+
+
+def test_none_optional_fields_are_dropped():
+    s = T.MemorySink()
+    e = s.emit("step", iter=3, loss=None, iter_ms=1.5)
+    assert "loss" not in e and e["iter_ms"] == 1.5
+
+
+def test_jsonl_sink_round_trip_exact_order(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    with T.JsonlSink(path) as s:
+        s.emit("run_start", model="m", world_size=8)
+        for i in range(50):
+            s.emit("step", iter=i, loss=float(i), iter_ms=1.0)
+        s.emit("run_end", summary={"ok": 1})
+    events, errors = T.read_events(path)
+    assert errors == []
+    assert len(events) == 52
+    assert [e["seq"] for e in events] == list(range(52))
+    assert [e["iter"] for e in events if e["type"] == "step"] == list(range(50))
+
+
+def test_jsonl_sink_flush_makes_events_visible(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    s = T.JsonlSink(path)
+    try:
+        s.emit("log", message="hello")
+        s.flush()
+        events, _ = T.read_events(path)
+        assert [e["message"] for e in events] == ["hello"]
+    finally:
+        s.close()
+
+
+def test_jsonl_sink_close_is_flush_and_idempotent(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    s = T.JsonlSink(path)
+    for i in range(10):
+        s.emit("step", iter=i)
+    s.close()
+    s.close()
+    assert len(T.read_events(path)[0]) == 10
+    with pytest.raises(T.TelemetryError, match="closed"):
+        s.emit("log", message="after close")
+
+
+def test_jsonl_writer_error_propagates_to_producer(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    s = T.JsonlSink(path)
+    s.emit("log", message="first")
+    s.flush()
+    s._fh.close()  # simulate the file dying under the writer thread
+    s.emit("log", message="second")  # the write fails on the worker
+    with pytest.raises(T.TelemetryError, match="telemetry writer failed"):
+        # surfaced on the producer side at the next boundary (flush or close)
+        s.flush()
+        s.close()
+
+
+def test_jsonl_sink_bad_path_fails_at_construction(tmp_path):
+    target = tmp_path / "not_a_dir"
+    target.write_text("file, not dir")
+    with pytest.raises(OSError):
+        T.JsonlSink(str(target / "t.jsonl"))
+
+
+def test_read_events_rejects_unknown_keys_and_collects_errors(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    good = {"v": 1, "t": 0.0, "seq": 0, "type": "log", "message": "ok"}
+    bad_key = dict(good, seq=1, smuggled="x")
+    bad_version = dict(good, seq=2, v=99)
+    with open(path, "w") as f:
+        for e in (good, bad_key, "not json at all", bad_version):
+            f.write((e if isinstance(e, str) else json.dumps(e)) + "\n")
+    events, errors = T.read_events(path, strict=False)
+    assert len(events) == 1 and len(errors) == 3
+    with pytest.raises(T.TelemetryError):
+        T.read_events(path, strict=True)
+
+
+def test_active_sink_routing_and_nesting():
+    outer, inner = T.MemorySink(), T.MemorySink()
+    assert T.emit("log", message="dropped") is None  # no sink: no-op
+    T.install(outer)
+    try:
+        T.emit("log", message="to outer")
+        T.install(inner)
+        try:
+            T.emit("log", message="to inner")
+        finally:
+            T.uninstall(inner)
+        T.emit("log", message="to outer again")
+    finally:
+        T.uninstall(outer)
+    assert [e["message"] for e in outer.events] == ["to outer", "to outer again"]
+    assert [e["message"] for e in inner.events] == ["to inner"]
+    assert T.active_sink() is None
+
+
+def test_runtime_log_prints_and_emits():
+    sink = T.MemorySink()
+    printed = []
+    T.install(sink)
+    try:
+        T.runtime_log("a line", print_fn=printed.append)
+    finally:
+        T.uninstall(sink)
+    assert printed == ["a line"]
+    assert [e["message"] for e in sink.events] == ["a line"]
+
+
+def test_emit_thread_safety_no_duplicate_seq(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    s = T.JsonlSink(path)
+
+    def worker(k):
+        for i in range(50):
+            s.emit("log", message="w%d-%d" % (k, i))
+
+    threads = [threading.Thread(target=worker, args=(k,)) for k in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    s.close()
+    events, errors = T.read_events(path)
+    assert errors == []
+    assert sorted(e["seq"] for e in events) == list(range(200))
+    assert os.path.exists(path)

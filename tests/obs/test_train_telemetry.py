@@ -1,0 +1,113 @@
+"""End-to-end: a CPU train run with --telemetry writes a schema-valid JSONL
+with per-step MFU and lifecycle events, and `cli report` analyzes it.
+
+ONE tiny train run is shared by every assertion here (module fixture) to
+respect the tier-1 wall-time budget."""
+
+import os
+
+import numpy as np
+import pytest
+
+from galvatron_tpu.cli.arguments import initialize_galvatron
+from galvatron_tpu.cli.train import train
+from galvatron_tpu.obs import report as R
+from galvatron_tpu.obs import telemetry as T
+
+ITERS = 4
+
+
+@pytest.fixture(scope="module")
+def telemetry_run(devices8, tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("telemetry")
+    tele = str(tmp / "run.jsonl")
+    argv = [
+        "--model_type", "llama", "--set_model_config_manually", "1",
+        "--hidden_size", "64", "--num_attention_heads", "4", "--num_layers", "2",
+        "--vocab_size", "128", "--seq_length", "32", "--mixed_precision", "fp32",
+        "--global_train_batch_size", "8", "--train_iters", str(ITERS),
+        "--lr", "1e-3", "--world_size", "8", "--telemetry", tele,
+        "--save", str(tmp / "ckpt"), "--log_interval", "1",
+    ]
+    summary = train(initialize_galvatron(mode="train_dist", argv=argv))
+    events, errors = T.read_events(tele)
+    return summary, events, errors, tele
+
+
+def by_type(events):
+    out = {}
+    for e in events:
+        out.setdefault(e["type"], []).append(e)
+    return out
+
+
+def test_stream_is_schema_valid(telemetry_run):
+    _, events, errors, _ = telemetry_run
+    assert errors == []
+    assert [e["seq"] for e in events] == list(range(len(events)))
+
+
+def test_per_step_events_carry_timing_loss_and_mfu(telemetry_run):
+    _, events, _, _ = telemetry_run
+    steps = by_type(events)["step"]
+    assert [e["iter"] for e in steps] == list(range(ITERS))
+    for e in steps:
+        assert e["iter_ms"] > 0
+        assert np.isfinite(e["loss"])
+        # CPU has a registry entry, so MFU is present and positive
+        assert e["mfu"] > 0 and e["model_flops_per_s"] > 0
+        assert e["dispatch_ms"] > 0
+        # host_blocked is a post-warmup measurement (profiler contract)
+        assert ("host_blocked_ms" in e) == (e["iter"] >= 2)
+
+
+def test_lifecycle_events_present(telemetry_run):
+    _, events, _, _ = telemetry_run
+    t = by_type(events)
+    run_start = t["run_start"][0]
+    assert run_start["world_size"] == 8 and run_start["start_iter"] == 0
+    assert run_start["model_flops_per_step"] > 0
+    assert run_start["peak_flops"] > 0
+    assert "strategy" in run_start and run_start["strategy"]["pp_deg"] == 1
+    comp = t["compile"][0]
+    assert comp["trace_ms"] > 0 and comp["compile_ms"] >= 0
+    assert comp["compiled_memory_mb"] > 0
+    assert t["checkpoint_save"][0]["iteration"] == ITERS
+    assert t["layer_run"], "per-LayerRun predictions missing"
+    assert t["run_end"][0]["summary"]["iters"] >= 1
+
+
+def test_summary_reports_mfu(telemetry_run):
+    summary, _, _, _ = telemetry_run
+    assert summary["model_flops_per_step"] > 0
+    assert summary["model_flops_per_s"] > 0
+    assert summary["mfu"] > 0
+    assert summary["compiled_step_memory_mb"] > 0
+
+
+def test_report_cli_renders_run(telemetry_run, capsys):
+    _, _, _, tele = telemetry_run
+    rc = R.run([tele])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "steady state" in out
+    assert "predicted vs measured per layer run" in out
+    assert "checkpoint_save" in out
+
+
+def test_train_log_single_handle(devices8, tmp_path):
+    """The log_iteration fix: the per-run log file is written through one
+    held handle (and still lands on disk after train() closes it)."""
+    d = str(tmp_path / "logs")
+    argv = [
+        "--model_type", "llama", "--set_model_config_manually", "1",
+        "--hidden_size", "64", "--num_attention_heads", "4", "--num_layers", "2",
+        "--vocab_size", "128", "--seq_length", "32", "--mixed_precision", "fp32",
+        "--global_train_batch_size", "8", "--train_iters", "3", "--lr", "1e-3",
+        "--world_size", "8", "--train_log_dir", d, "--log_interval", "1",
+    ]
+    train(initialize_galvatron(mode="train_dist", argv=argv))
+    files = os.listdir(d)
+    assert len(files) == 1
+    lines = open(os.path.join(d, files[0])).read().strip().splitlines()
+    assert len(lines) == 3 and lines[0].startswith("iter")

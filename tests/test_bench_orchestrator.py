@@ -57,3 +57,47 @@ def test_full_smoke_bench_on_cpu():
     assert ts["step_ms"] > 0 and ts["tokens_per_sec_per_chip"] > 0
     assert ts["compile_ms"] > 0
     assert out["extra"]["masked_flash"]["masked_vs_unmasked"] > 0
+
+
+def test_mfu_regression_gate_exit_codes(tmp_path):
+    """ROADMAP item 1 acceptance: with the gate enabled, an injected MFU
+    regression vs the most recent non-empty baseline exits non-zero (with an
+    explicit report line); matching numbers, absent baselines, and
+    absent-numbers rounds pass. Uses the canned-results seam — no jax, no
+    chip, milliseconds."""
+    baseline = {"n": 3, "parsed": {
+        "metric": "gpt_layer_fwd_ms_per_layer_per_sample_h4096_s2048_bf16",
+        "value": 5.0, "extra": {"train_step": {"mfu": 0.4,
+                                               "tokens_per_sec_per_chip": 30000.0}}}}
+    empty_round = {"n": 4, "parsed": None}  # wedged round: tolerated, skipped
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps(baseline))
+    (tmp_path / "BENCH_r04.json").write_text(json.dumps(empty_round))
+
+    def run_gate(mfu, gate="1"):
+        fake = tmp_path / "fake.json"
+        fake.write_text(json.dumps({"results": {"train_step": {
+            "mfu": mfu, "tokens_per_sec_per_chip": 30000.0}}}))
+        env = dict(os.environ,
+                   GALVATRON_BENCH_FAKE_RESULTS=str(fake),
+                   GALVATRON_BENCH_GATE=gate,
+                   GALVATRON_BENCH_BASELINE_GLOB=str(tmp_path / "BENCH_r*.json"))
+        return subprocess.run([sys.executable, BENCH], env=env,
+                              capture_output=True, text=True, timeout=60)
+
+    p = run_gate(0.2)  # -50%: regression
+    assert p.returncode == 1, p.stdout
+    assert "MFU-REGRESSION" in p.stdout and "train_step.mfu" in p.stdout
+    p = run_gate(0.39)  # -2.5%: within the 10% tolerance
+    assert p.returncode == 0, p.stdout
+    p = run_gate(0.2, gate="")  # gate off: wedge-proofing contract holds
+    assert p.returncode == 0 and "MFU-REGRESSION" not in p.stdout
+    # no usable baseline at all: tolerated
+    env_dir = tmp_path / "empty"
+    env_dir.mkdir()
+    fake = tmp_path / "fake.json"
+    env = dict(os.environ, GALVATRON_BENCH_FAKE_RESULTS=str(fake),
+               GALVATRON_BENCH_GATE="1",
+               GALVATRON_BENCH_BASELINE_GLOB=str(env_dir / "*.json"))
+    p = subprocess.run([sys.executable, BENCH], env=env, capture_output=True,
+                       text=True, timeout=60)
+    assert p.returncode == 0 and "no usable baseline" in p.stdout
