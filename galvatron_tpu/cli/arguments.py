@@ -215,6 +215,31 @@ def _add_train_args(p: argparse.ArgumentParser):
                         "(default: the budget recorded in the checkpoint's "
                         "provenance, else %.0f GB); also recorded into new "
                         "checkpoints' provenance" % 16.0)
+    # self-healing runs (runtime/health.py + runtime/elastic.migrate): the
+    # training watchdog, the periodic mesh-health probe, and live in-memory
+    # strategy migration (no checkpoint round-trip)
+    r.add_argument("--watchdog", type=float, default=0.0,
+                   help="arm the training watchdog with this additive floor "
+                        "in seconds (0 = off): a step making no progress for "
+                        "watchdog_factor * median(step time) + floor seconds "
+                        "first drains-and-retries, then emergency-saves and "
+                        "exits with code 3")
+    r.add_argument("--watchdog_factor", type=float, default=4.0,
+                   help="k in the learned watchdog deadline "
+                        "k * median(steady step time) + --watchdog floor")
+    r.add_argument("--watchdog_startup_s", type=float, default=600.0,
+                   help="watchdog deadline before enough steps have drained "
+                        "to learn one (first-step compiles take minutes)")
+    r.add_argument("--mesh_probe_interval", type=float, default=0.0,
+                   help="seconds between mesh-health probes (device "
+                        "enumeration diff + tiny jitted collective under a "
+                        "timeout; 0 = off)")
+    r.add_argument("--migrate_on_degrade", type=int, default=0,
+                   help="when the mesh probe reports a degraded world, "
+                        "live-migrate to a strategy for the surviving "
+                        "devices in memory (--elastic_strategy JSON if "
+                        "given, else a fresh search) instead of exiting; "
+                        "SIGUSR1 triggers the same migration manually")
 
 
 def _add_profile_args(p: argparse.ArgumentParser):

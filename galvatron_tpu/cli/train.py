@@ -9,7 +9,9 @@ from __future__ import annotations
 
 import hashlib
 import os
+import signal
 import sys
+import threading
 import time
 from collections import OrderedDict, deque
 from typing import Optional
@@ -31,11 +33,12 @@ from galvatron_tpu.profiler.runtime import (
     device_memory_stats,
 )
 from galvatron_tpu.runtime import checkpoint as ckpt
+from galvatron_tpu.runtime import health as hlth
 from galvatron_tpu.runtime import resilience as rsl
 from galvatron_tpu.runtime.dataloader import get_train_iterator
 from galvatron_tpu.runtime.model_api import construct_hybrid_parallel_model
 from galvatron_tpu.runtime.optimizer import OptimizerArgs, get_optimizer_and_scheduler
-from galvatron_tpu.runtime.prefetch import PrefetchIterator
+from galvatron_tpu.runtime.prefetch import PrefetchIterator, PrefetchStalledError
 
 
 # In-process memo of AOT-compiled train-step executables, keyed by (device
@@ -416,6 +419,41 @@ def _train(args) -> dict:
         prefetch_depth = 0
         inflight_window = 0
 
+    # -------------------------------------------------------- self-healing
+    # Watchdog (runtime/health.py): a monitor thread armed around every
+    # loop body, deadline learned from the steady-state step time. A missed
+    # deadline first requests a drain-and-retry; a second miss with no
+    # progress requests the emergency-save exit (exit code 3 via main()).
+    wd = None
+    if getattr(args, "watchdog", 0):
+        wd = hlth.Watchdog(hlth.WatchdogConfig(
+            floor_s=float(args.watchdog),
+            factor=float(getattr(args, "watchdog_factor", 4.0)),
+            startup_deadline_s=float(getattr(args, "watchdog_startup_s", 600.0)),
+        )).start()
+    # Mesh-health probe: enumeration diff + tiny collective every interval,
+    # consulted at step boundaries (where a degraded verdict can be acted
+    # on). `probe_devices_fn` is a test seam for simulated device loss.
+    mesh_monitor = None
+    if getattr(args, "mesh_probe_interval", 0):
+        mesh_monitor = hlth.MeshHealthMonitor(
+            model.mesh,
+            interval_s=float(args.mesh_probe_interval),
+            devices_fn=getattr(args, "probe_devices_fn", None),
+        )
+    # Live-migration requests: set by SIGUSR1 (manual re-plan), by a
+    # degraded mesh-probe verdict under --migrate_on_degrade, or by tests;
+    # consumed at the next step boundary where params/opt_state are
+    # consistent.
+    migrate_req = {"pending": False, "reason": None, "world": None}
+    prev_usr1 = None
+    if hasattr(signal, "SIGUSR1") and \
+            threading.current_thread() is threading.main_thread():
+        def _on_usr1(signum, frame):
+            migrate_req.update(pending=True, reason="sigusr1", world=None)
+
+        prev_usr1 = signal.signal(signal.SIGUSR1, _on_usr1)
+
     def _retrying(it_):
         """Per-batch retry (transient dataloader I/O) as an iterator, so the
         prefetch worker keeps the same backoff the sync path has."""
@@ -444,13 +482,30 @@ def _train(args) -> dict:
         if prefetch_depth > 0:
             stream["prefetch"] = PrefetchIterator(
                 _retrying(it_), depth=prefetch_depth, place_fn=model.shard_batch,
+                # bound the wait on a live-but-unproductive producer by the
+                # watchdog's current deadline so a wedged place_fn surfaces
+                # as a diagnosed stall, not an indefinite driver hang
+                stall_timeout=wd.deadline_s() if wd is not None else None,
             )
         else:
             stream["iter"] = it_
 
     def next_batch():
         if stream["prefetch"] is not None:
-            return next(stream["prefetch"])  # sharded by the prefetch worker
+            try:
+                return next(stream["prefetch"])  # sharded by the prefetch worker
+            except PrefetchStalledError as e:
+                # one recovery attempt: report through the watchdog event
+                # stream, rebuild the pipeline at the current step (exact
+                # replay — streams are functions of the step index), retry;
+                # a second stall propagates and fails the run honestly
+                telemetry.emit("watchdog", action="prefetch_stall", iter=it,
+                               detail=str(e))
+                telemetry.runtime_log(
+                    "prefetch stalled at iteration %d: %s — rebuilding the "
+                    "input pipeline" % (it, e))
+                open_stream(it)
+                return next(stream["prefetch"])
         b = rsl.with_retry(lambda: next(stream["iter"]), retry_policy, res,
                            description="dataloader")
         return model.shard_batch(b)
@@ -607,6 +662,11 @@ def _train(args) -> dict:
         rollback_needed)."""
         d_it, metrics, disp_ms = inflight.popleft()
         prof.end(d_it, n_samples=hp.global_bsz, outputs=metrics["loss"])
+        if wd is not None:
+            # a drain is the loop's liveness signal AND the deadline's
+            # training data (the learned budget tracks the steady step time)
+            wd.observe_step_time(prof.all_times_ms[-1])
+            wd.progress(d_it, inflight=len(inflight))
         if args.profile or d_it % max(args.log_interval, 1) == 0:
             prof.log_iteration(d_it, metrics)
         loss = float(metrics["loss"])
@@ -685,6 +745,81 @@ def _train(args) -> dict:
             return True
         return False
 
+    def do_migrate(reason: str, target_world: Optional[int] = None) -> bool:
+        """Live in-memory strategy migration (runtime/elastic.migrate): at a
+        step boundary with the in-flight window drained and the prefetch
+        thread torn down, resolve a strategy for `target_world` (operator
+        JSON or a fresh search), relayout params + adam moments on-device,
+        rebuild the model + step function (recompiling through the
+        in-process executable memo), and reopen the input pipeline at the
+        SAME step — the trajectory continues as if the run had been
+        checkpointed and resumed under the target strategy, minus the disk
+        round-trip. Returns True when a swap happened; refusals raise the
+        GLS2xx DiagnosticError contract (GLS207 for migration-specific
+        infeasibility)."""
+        nonlocal model, hp, params, opt_state, step_fn, provenance, \
+            eval_fn, mesh_monitor
+        if wd is not None:
+            wd.disarm()
+        if drain_inflight(0):
+            # the guard demanded a rollback while draining: the restored
+            # trajectory wins this boundary; the migration request is dropped
+            # (the next probe/SIGUSR1 re-raises it against the restored run)
+            return False
+        world = int(target_world or len(jax.devices()))
+        new_hp, action = els.resolve_migration_strategy(args, cfg, world, hp)
+        if new_hp.to_json_dict() == hp.to_json_dict() and world == hp.world_size:
+            # resolve BEFORE tearing anything down: a no-op request (already
+            # on the target strategy — e.g. a repeated trigger) leaves the
+            # stream and model untouched
+            telemetry.runtime_log(
+                "migration (%s): resolved strategy is identical to the "
+                "running one; nothing to swap" % reason)
+            return False
+        close_stream()
+        devs = jax.devices()[:world] if world != hp.world_size else None
+        build = None
+        if fam.build:
+            build = lambda c, h, d=None: fam.build(c, h)  # noqa: E731
+        result = els.migrate(
+            model, params, opt_state, tx, new_hp, devices=devs,
+            build_model=build, reason=reason, iteration=it,
+        )
+        model, params, opt_state = result.model, result.params, result.opt_state
+        hp = new_hp
+        provenance = els.build_provenance(
+            hp, cfg, optimizer_args_from(args), mesh=model.mesh,
+            memory_budget_gb=getattr(args, "elastic_memory_gb", None))
+        step_fn = model.make_train_step(
+            tx, guard_anomalies=guard is not None,
+            donate=bool(getattr(args, "donate_step", 1)),
+        )
+        if hooks is not None and hooks.wrap_step_fn:
+            step_fn = hooks.wrap_step_fn(step_fn)
+        _aot["fn"] = None  # re-lower; the executable memo absorbs repeats
+        if eval_fn is not None:
+            eval_fn = jax.jit(model.eval_loss)
+            for split in eval_batches:
+                # device_put onto the new model's batch shardings (committed
+                # arrays reshard in place; values are unchanged)
+                eval_batches[split] = [
+                    model.shard_batch(b) for b in eval_batches[split]]
+        if mesh_monitor is not None:
+            mesh_monitor = hlth.MeshHealthMonitor(
+                model.mesh, interval_s=mesh_monitor.interval_s,
+                devices_fn=getattr(args, "probe_devices_fn", None),
+            )
+        open_stream(it)
+        if jax.process_index() == 0:
+            print(
+                "live migration (%s/%s) at iteration %d: world %d -> %d, "
+                "%s relayout"
+                % (reason, action, it, result.from_hp.world_size,
+                   hp.world_size,
+                   "same-tree" if result.same_layout else "cross-layout")
+            )
+        return True
+
     try:
         while True:
             if interrupted is None and it < args.train_iters:
@@ -693,6 +828,43 @@ def _train(args) -> dict:
                 if preempt is not None and preempt.triggered:
                     interrupted = preempt.signal_name
                     telemetry.emit("preemption", signal=interrupted, iter=it)
+                if wd is not None and interrupted is None:
+                    if wd.abort_requested:
+                        # second missed deadline with no progress: take the
+                        # emergency-save exit path; main() maps the summary
+                        # to WATCHDOG_EXIT_CODE
+                        interrupted = "watchdog"
+                    elif wd.take_retry_request():
+                        # first missed deadline: drain whatever the device
+                        # will still give us and keep going
+                        telemetry.runtime_log(
+                            "watchdog: draining %d in-flight step(s) after "
+                            "stall at iteration %d" % (len(inflight), it))
+                        if drain_inflight(0):
+                            continue
+                if interrupted is None and mesh_monitor is not None:
+                    verdict = mesh_monitor.maybe_probe()
+                    if verdict is not None and verdict["status"] != "healthy":
+                        telemetry.emit(
+                            "watchdog", action="mesh_probe", iter=it,
+                            status=verdict["status"],
+                            expected=verdict["expected"], live=verdict["live"],
+                            missing_ids=verdict["missing_ids"] or None,
+                            detail=verdict.get("error"),
+                        )
+                        telemetry.runtime_log(
+                            "mesh probe: %s (expected %d devices, live %d)"
+                            % (verdict["status"], verdict["expected"],
+                               verdict["live"]))
+                        if verdict["status"] == "degraded" and \
+                                getattr(args, "migrate_on_degrade", 0):
+                            migrate_req.update(
+                                pending=True, reason="degraded_mesh",
+                                world=verdict["live"])
+                if interrupted is None and migrate_req["pending"]:
+                    migrate_req.update(pending=False)
+                    do_migrate(migrate_req["reason"], migrate_req["world"])
+                    continue
             if interrupted is not None or it >= args.train_iters:
                 # loop exit: forced full drain first. A rollback surfacing in
                 # the final drain resumes training at the restored iteration
@@ -700,7 +872,11 @@ def _train(args) -> dict:
                 # emergency save (of the rolled-back state) takes priority.
                 if drain_inflight(0) and interrupted is None:
                     continue
+                if wd is not None:
+                    wd.disarm()  # the exit saves are not step work
                 break
+            if wd is not None:
+                wd.arm(it, "fetch", inflight=len(inflight))
             batch = next_batch()
             maybe_start_trace(it)
             prof.start(it)
@@ -714,12 +890,16 @@ def _train(args) -> dict:
                 params, opt_state, metrics = compiled_step(params, opt_state, batch)
             disp_ms = prof.dispatched(it)
             inflight.append((it, metrics, disp_ms))
+            if wd is not None:
+                wd.arm(it, "inflight", inflight=len(inflight))
             it += 1
             if drain_inflight(inflight_window):
                 continue
             if eval_interval and it % eval_interval == 0:
                 if drain_inflight(0):  # forced drain before every eval
                     continue
+                if wd is not None:
+                    wd.disarm()  # eval passes are legitimately slow
                 vloss = evaluate(params, "valid")
                 valid_losses.append((it, vloss))
                 telemetry.emit("eval", iter=it, split="valid", loss=vloss)
@@ -728,6 +908,8 @@ def _train(args) -> dict:
             if args.save and args.save_interval and it % args.save_interval == 0:
                 if drain_inflight(0):  # forced drain before every save
                     continue
+                if wd is not None:
+                    wd.disarm()  # checkpoint I/O has its own retry containment
                 save_now(it)
                 last_save = it
         if interrupted is not None and args.save and last_save != it:
@@ -749,10 +931,16 @@ def _train(args) -> dict:
         prof.close()
         if preempt is not None:
             preempt.uninstall()
+        if wd is not None:
+            wd.stop()
+        if prev_usr1 is not None:
+            signal.signal(signal.SIGUSR1, prev_usr1)
     prof.resilience_counters = res.as_dict()
     summary = prof.summary()
     summary["losses"] = losses
     summary["resilience"] = res.as_dict()
+    if wd is not None:
+        summary["watchdog"] = wd.summary()
     if interrupted is not None:
         summary["interrupted"] = interrupted
     if eval_interval:
@@ -772,7 +960,7 @@ def _train(args) -> dict:
 def main(argv=None):
     args = initialize_galvatron(mode="train_dist", argv=argv)
     try:
-        return train(args)
+        summary = train(args)
     except Exception as e:
         from galvatron_tpu.analysis.diagnostics import DiagnosticError
 
@@ -786,6 +974,14 @@ def main(argv=None):
                 print(d.format(), file=sys.stderr)
             sys.exit(2)
         raise
+    if (summary.get("watchdog") or {}).get("escalated"):
+        # the run wedged, evacuated through the emergency save, and exited
+        # cleanly: a DISTINCT exit code (3) tells the supervisor "resume me,
+        # and look at the watchdog events" rather than "retry blindly"
+        print("watchdog escalated: emergency state saved; exiting %d"
+              % hlth.WATCHDOG_EXIT_CODE, file=sys.stderr)
+        sys.exit(hlth.WATCHDOG_EXIT_CODE)
+    return summary
 
 
 if __name__ == "__main__":

@@ -38,9 +38,13 @@ from galvatron_tpu.obs import telemetry as T
 # lifecycle event types surfaced on the timeline, in schema order
 TIMELINE_TYPES = (
     "compile", "checkpoint_save", "checkpoint_restore", "checkpoint_gc",
-    "anomaly_skip", "rollback", "retry", "preemption", "elastic", "trace",
-    "eval",
+    "anomaly_skip", "rollback", "retry", "preemption", "watchdog", "elastic",
+    "trace", "eval",
 )
+
+# timeline rendering: the watchdog's stack dump and a migration's full
+# strategy JSON are post-mortem payloads, not one-line timeline material
+_TIMELINE_ELIDED_KEYS = ("stacks", "from_strategy", "to_strategy")
 
 
 # ---------------------------------------------------------- steady state
@@ -111,7 +115,7 @@ def analyze(
     ) if predictions else []
 
     timeline = [
-        {k: v for k, v in e.items() if k not in ("v",)}
+        {k: v for k, v in e.items() if k not in ("v",) + _TIMELINE_ELIDED_KEYS}
         for e in sorted(
             (e for t in TIMELINE_TYPES for e in by_type.get(t, [])),
             key=lambda e: e["seq"],
@@ -142,6 +146,16 @@ def analyze(
             "skipped": len(by_type.get("anomaly_skip", [])),
             "rollbacks": len(by_type.get("rollback", [])),
             "retries": len(by_type.get("retry", [])),
+        },
+        "health": {
+            "watchdog_fires": sum(
+                1 for e in by_type.get("watchdog", []) if e.get("action") == "fire"),
+            "watchdog_escalations": sum(
+                1 for e in by_type.get("watchdog", [])
+                if e.get("action") == "escalate"),
+            "migrations": sum(
+                1 for e in by_type.get("elastic", [])
+                if e.get("action") == "migrate"),
         },
         "divergence": divergence,
         "timeline": timeline,

@@ -85,8 +85,25 @@ EVENT_SCHEMAS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
     "rollback": (("to_iter",), ("at_iter", "count", "stream_offset")),
     "retry": (("description", "attempt"), ("error", "delay_s")),
     "preemption": (("signal",), ("iter",)),
-    # lifecycle: elastic resume / re-search
-    "elastic": (("action",), ("saved_world", "live_world")),
+    # the training watchdog (runtime/health.py): a missed progress deadline
+    # ("fire" -> drain-and-retry, "escalate" -> emergency save + exit 3),
+    # a stalled prefetch producer, or a degraded/wedged mesh-probe verdict —
+    # each with the diagnostic dump the post-mortem needs (in-flight window
+    # depth, last drained step, per-thread stacks)
+    "watchdog": (
+        ("action",),
+        ("iter", "phase", "elapsed_s", "deadline_s", "inflight_depth",
+         "last_drained", "fires", "stacks", "detail", "status",
+         "expected", "live", "missing_ids"),
+    ),
+    # lifecycle: elastic resume / re-search; action="migrate" is the LIVE
+    # in-memory strategy swap (runtime/elastic.migrate) and carries the full
+    # before/after strategy JSON
+    "elastic": (
+        ("action",),
+        ("saved_world", "live_world", "reason", "iter", "from_strategy",
+         "to_strategy", "duration_ms", "same_layout"),
+    ),
     # per-LayerRun prediction record (obs/attribution.py): what the search
     # engine's cost models expect, so the report can lay measured numbers
     # beside it

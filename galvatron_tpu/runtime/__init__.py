@@ -1,5 +1,13 @@
+from galvatron_tpu.runtime.health import (
+    WATCHDOG_EXIT_CODE,
+    MeshHealthMonitor,
+    Watchdog,
+    WatchdogConfig,
+    classify_world,
+)
 from galvatron_tpu.runtime.model_api import HybridParallelModel, construct_hybrid_parallel_model
 from galvatron_tpu.runtime.optimizer import get_optimizer_and_scheduler
+from galvatron_tpu.runtime.prefetch import PrefetchIterator, PrefetchStalledError
 from galvatron_tpu.runtime.resilience import (
     AnomalyGuard,
     AnomalyGuardConfig,
@@ -15,6 +23,13 @@ __all__ = [
     "HybridParallelModel",
     "construct_hybrid_parallel_model",
     "get_optimizer_and_scheduler",
+    "WATCHDOG_EXIT_CODE",
+    "MeshHealthMonitor",
+    "Watchdog",
+    "WatchdogConfig",
+    "classify_world",
+    "PrefetchIterator",
+    "PrefetchStalledError",
     "AnomalyGuard",
     "AnomalyGuardConfig",
     "FaultHooks",

@@ -500,7 +500,7 @@ def load_checkpoint(
         if saved_strategy is None:
             saved_strategy = _read_saved_strategy(ckpt_dir, iteration, target_hp.world_size)
         cross = saved_strategy is not None and not _same_param_layout(saved_strategy, target_hp)
-        target_abs_params = jax.eval_shape(target._init_fn, jax.random.PRNGKey(0))
+        target_abs_params = target.abstract_params()
         if cross and target.init_fn is not None:
             raise D.DiagnosticError([D.make(
                 "GLS206", "cross-pipeline-layout restore (pp %s -> pp %s) is "

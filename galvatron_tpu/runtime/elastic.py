@@ -29,6 +29,21 @@ On resume with ``--elastic {resume,search}`` the driver calls
 The actual cross-strategy restore (different shardings, different pipeline
 layout, opt_state re-sharded leaf-wise with structural checks) is
 ``load_checkpoint(..., target=)`` in runtime/checkpoint.py.
+
+Live in-memory migration
+------------------------
+:func:`migrate` is the no-disk sibling of the cross-strategy restore: it
+moves the LIVE params + optimizer state from the running model onto a new
+strategy's model entirely on-device — the same ``_relayout_tree`` family
+re-lays out pipeline-layout changes, a plain sharded ``device_put`` handles
+everything else — so a degraded or re-planned run swaps strategies mid-
+process and continues from the same step, bitwise-identical to a
+checkpoint round-trip under the target strategy (pinned by
+tests/cli/test_migration.py). :func:`resolve_migration_strategy` picks the
+target (operator-supplied JSON or a fresh search for the surviving world)
+and refuses infeasible migrations with GLS207; the driver wires both to
+the watchdog / mesh-health probe (runtime/health.py) and to a SIGUSR1
+manual trigger.
 """
 
 from __future__ import annotations
@@ -37,6 +52,7 @@ import dataclasses
 import hashlib
 import json
 import os
+import time
 from dataclasses import dataclass
 from typing import Any, Dict, Optional, Tuple
 
@@ -354,19 +370,29 @@ def resolve_resume_strategy(
     budget = getattr(args, "elastic_memory_gb", None) or prov.get(
         "memory_budget_gb") or DEFAULT_MEMORY_GB
 
-    if saved_world == live_world:
+    strategy_file = getattr(args, "elastic_strategy", None)
+    if saved_world == live_world and not strategy_file:
         # nothing changed: resume under the saved strategy, bitwise identical
         # to a plain --load (the checkpoint's strategy wins over GLOBAL flags
-        # so a stale launch script cannot silently fork the trajectory)
+        # so a stale launch script cannot silently fork the trajectory). An
+        # EXPLICIT --elastic_strategy is different from stale flags: the
+        # operator deliberately re-plans (e.g. validating a live-migration
+        # target offline), so it is honored below even on a matching world.
         telemetry.emit(
             "elastic", action="match", saved_world=saved_world,
             live_world=live_world)
         return ElasticPlan("match", saved_hp, saved_hp, prov, it)
 
-    strategy_file = getattr(args, "elastic_strategy", None)
     if strategy_file:
         hp = HybridParallelConfig.from_json(
             strategy_file, world_size=live_world, **exec_kw)
+        if saved_world == live_world and hp.to_json_dict() == saved_hp.to_json_dict():
+            # the supplied file IS the saved strategy: the cheaper bitwise
+            # same-strategy restore applies
+            telemetry.emit(
+                "elastic", action="match", saved_world=saved_world,
+                live_world=live_world)
+            return ElasticPlan("match", saved_hp, saved_hp, prov, it)
         if hp.global_bsz != saved_hp.global_bsz:
             telemetry.runtime_log(
                 "elastic: --elastic_strategy changes global_bsz %d -> %d; "
@@ -412,3 +438,174 @@ def resolve_resume_strategy(
     telemetry.emit(
         "elastic", action=action, saved_world=saved_world, live_world=live_world)
     return ElasticPlan(action, hp, saved_hp, prov, it)
+
+
+# ------------------------------------------------------- in-memory migration
+@dataclass
+class MigrationResult:
+    """What :func:`migrate` produced: run `model` with `params`/`opt_state`
+    from here on. `same_layout` records whether the swap was a pure
+    on-device reshard (no host round trip, no tree rewrite)."""
+
+    model: Any
+    params: Any
+    opt_state: Any
+    same_layout: bool
+    from_hp: HybridParallelConfig
+    to_hp: HybridParallelConfig
+
+
+def resolve_migration_strategy(
+    args: Any,
+    model_cfg: Any,
+    live_world: int,
+    current_hp: HybridParallelConfig,
+) -> Tuple[HybridParallelConfig, str]:
+    """Pick the target strategy for a LIVE migration: the operator-supplied
+    ``--elastic_strategy`` JSON when given, otherwise a fresh search for
+    `live_world` under the memory budget. Returns (hp, action).
+
+    Raises DiagnosticError: GLS203 when nothing fits the budget, GLS207
+    when the candidate would fork the training trajectory (a different
+    global batch makes "continue from the same step" meaningless — unlike
+    a disk resume, a live migration exists only to preserve the run)."""
+    exec_kw = dict(
+        scan_layers=current_hp.scan_layers,
+        remat_policy=current_hp.remat_policy,
+        mixed_precision=current_hp.mixed_precision,
+    )
+    budget = getattr(args, "elastic_memory_gb", None) or DEFAULT_MEMORY_GB
+    strategy_file = getattr(args, "elastic_strategy", None)
+    if strategy_file:
+        hp = HybridParallelConfig.from_json(
+            strategy_file, world_size=live_world, **exec_kw)
+        action = "strategy_file"
+    else:
+        hp = search_surviving_strategy(
+            model_cfg, live_world, current_hp.global_bsz, budget,
+            model_type=getattr(args, "model_type", "model"),
+            config_dir=getattr(args, "config_dir", None),
+            default_dp_type=current_hp.default_dp_type,
+        )
+        if hp is None:
+            raise D.DiagnosticError([D.make(
+                "GLS203", "no strategy for %d surviving devices fits "
+                "global_bsz=%d under the %.1f GB budget; supply one with "
+                "--elastic_strategy or raise --elastic_memory_gb"
+                % (live_world, current_hp.global_bsz, budget),
+            )])
+        for k, v in exec_kw.items():
+            setattr(hp, k, v)
+        action = "search"
+    if hp.global_bsz != current_hp.global_bsz:
+        raise D.DiagnosticError([D.make(
+            "GLS207", "live migration cannot change global_bsz (%d -> %d): "
+            "the run would fork its own trajectory; stop and resume from a "
+            "checkpoint instead" % (current_hp.global_bsz, hp.global_bsz),
+        )])
+    from galvatron_tpu.analysis import strategy_lint as _slint
+
+    report = _slint.lint_hp(hp, model_cfg=model_cfg)
+    if not report.ok:
+        raise D.DiagnosticError(report.errors)
+    if action == "strategy_file":
+        refusal = _budget_refusal(hp, model_cfg, budget)
+        if refusal is not None:
+            raise D.DiagnosticError([refusal])
+    return hp, action
+
+
+def migrate(
+    model: Any,
+    params: Any,
+    opt_state: Any,
+    tx: Any,
+    target_hp: HybridParallelConfig,
+    devices: Any = None,
+    build_model: Any = None,
+    reason: str = "manual",
+    iteration: Optional[int] = None,
+) -> MigrationResult:
+    """Hot-swap the LIVE training state onto `target_hp` without a
+    checkpoint round-trip.
+
+    - Same pipeline layout (the common case — dp<->tp<->zero reshards,
+      world shrink/grow with unchanged stacking): the params/opt_state
+      TREES are already right, so the move is one sharded ``device_put``
+      per tree onto the new model's shardings — pure on-device data
+      movement, bit-exact.
+    - Pipeline-layout change (pp on/off, different division): the stacked
+      ``stages`` tree is re-laid-out leaf-exactly through the same
+      ``_relayout_tree`` family the cross-layout checkpoint restore uses,
+      then placed. Adam moments travel with their params.
+    - Refusals (GLS207): custom-param-tree families (t5/swin) across
+      layouts — ``_relayout_tree`` only knows the generic transformer tree
+      — and an opt_state whose re-laid-out structure does not match the
+      target optimizer's (corrupting moments silently would be worse than
+      stopping).
+
+    `build_model` overrides model construction for families with their own
+    build hook; `devices` selects the surviving device subset on a shrink.
+    The swap is logged as an ``elastic`` telemetry event carrying the full
+    before/after strategy JSON."""
+    import jax
+
+    from galvatron_tpu.runtime import checkpoint as ckpt
+    from galvatron_tpu.runtime.model_api import construct_hybrid_parallel_model
+
+    old_hp: HybridParallelConfig = model.hp
+    same_layout = ckpt._same_param_layout(old_hp, target_hp)
+    if not same_layout and model.init_fn is not None:
+        raise D.DiagnosticError([D.make(
+            "GLS207", "live migration across pipeline layouts (pp %s -> pp "
+            "%s) is only supported for the generic transformer tree; this "
+            "family builds its own params" % (old_hp.pp, target_hp.pp),
+        )])
+    if target_hp.global_bsz != old_hp.global_bsz:
+        raise D.DiagnosticError([D.make(
+            "GLS207", "live migration cannot change global_bsz (%d -> %d)"
+            % (old_hp.global_bsz, target_hp.global_bsz),
+        )])
+    t0 = time.perf_counter()
+    if build_model is not None:
+        new_model = build_model(model.cfg, target_hp, devices)
+    else:
+        new_model = construct_hybrid_parallel_model(model.cfg, target_hp, devices)
+
+    if same_layout:
+        new_params = jax.device_put(params, new_model.shardings())
+    else:
+        new_params = jax.device_put(
+            ckpt._relayout_tree(params, old_hp, target_hp), new_model.shardings())
+
+    new_opt = opt_state
+    if opt_state is not None and tx is not None:
+        relaid = opt_state if same_layout else ckpt._relayout_tree(
+            opt_state, old_hp, target_hp)
+        target_abs_params = new_model.abstract_params()
+        target_abs_opt = jax.eval_shape(tx.init, target_abs_params)
+        got = [(jax.tree_util.keystr(p), tuple(l.shape)) for p, l in
+               jax.tree_util.tree_flatten_with_path(relaid)[0]]
+        want = [(jax.tree_util.keystr(p), tuple(l.shape)) for p, l in
+                jax.tree_util.tree_flatten_with_path(target_abs_opt)[0]]
+        if got != want:
+            diffs = [(g, w) for g, w in zip(got, want) if g != w][:3]
+            raise D.DiagnosticError([D.make(
+                "GLS207", "re-laid-out opt_state does not match the target "
+                "optimizer tree (%d vs %d leaves; first diffs: %s)"
+                % (len(got), len(want), diffs),
+            )])
+        new_opt = jax.device_put(
+            relaid, new_model.opt_state_shardings(tx, target_abs_params))
+
+    telemetry.emit(
+        "elastic", action="migrate", reason=reason, iter=iteration,
+        saved_world=old_hp.world_size, live_world=target_hp.world_size,
+        from_strategy=old_hp.to_json_dict(), to_strategy=target_hp.to_json_dict(),
+        duration_ms=(time.perf_counter() - t0) * 1e3,
+        same_layout=same_layout,
+    )
+    return MigrationResult(
+        model=new_model, params=new_params, opt_state=new_opt,
+        same_layout=same_layout, from_hp=old_hp, to_hp=target_hp,
+    )

@@ -84,6 +84,12 @@ class HybridParallelModel:
             params["stages"] = stack_params(params.pop("layers"), self.hp)
         return params
 
+    def abstract_params(self) -> Params:
+        """Abstract (ShapeDtypeStruct) params tree for this model — the
+        shared currency of cross-layout checkpoint restore and live
+        in-memory migration (structure + shapes, no device work)."""
+        return jax.eval_shape(self._init_fn, jax.random.PRNGKey(0))
+
     def init_params(self, rng) -> Params:
         """Sharded init: jit with out_shardings so each device materialises
         only its shard (the analogue of meta-device init + shard streaming,
@@ -166,7 +172,7 @@ class HybridParallelModel:
         """Accumulated-grad shardings: dp-sharded wherever ZeRO applies, so the
         per-microbatch reduction is a reduce-scatter not an all-reduce
         (reference grad_reduce.py:47-64 no-sync + flush semantics)."""
-        shapes = jax.eval_shape(self._init_fn, jax.random.PRNGKey(0))
+        shapes = self.abstract_params()
         mesh_shape = dict(self.mesh.shape)
         from galvatron_tpu.runtime.optimizer import _shard_moment_spec
 

@@ -38,17 +38,35 @@ from __future__ import annotations
 
 import queue
 import threading
-from typing import Callable, Iterator, Optional
+import time
+from typing import Callable, Dict, Iterator, Optional
 
-__all__ = ["PrefetchIterator"]
+__all__ = ["PrefetchIterator", "PrefetchStalledError"]
 
 _ITEM, _DONE, _ERROR = "item", "done", "error"
+
+
+class PrefetchStalledError(RuntimeError):
+    """The producer thread is alive but produced nothing within the stall
+    timeout — a wedged ``place_fn`` (a device_put stuck on a sick
+    interconnect) or a hung source iterator. Carries the diagnostics the
+    watchdog event wants; raising (instead of blocking forever) is what
+    lets the driver surface the stall instead of silently hanging."""
+
+    def __init__(self, message: str, diagnostics: Optional[Dict] = None):
+        super().__init__(message)
+        self.diagnostics = dict(diagnostics or {})
 
 
 class PrefetchIterator:
     """Wrap ``source`` so host batch prep + device placement run ahead of
     the consumer on a background thread. Iterator protocol + context
-    manager; ``close()`` is idempotent."""
+    manager; ``close()`` is idempotent.
+
+    `stall_timeout` (seconds) bounds how long :meth:`get`/``__next__`` will
+    wait on a live-but-unproductive worker before raising
+    :class:`PrefetchStalledError` (None = wait forever, the pre-watchdog
+    behavior)."""
 
     def __init__(
         self,
@@ -56,6 +74,7 @@ class PrefetchIterator:
         depth: int = 2,
         place_fn: Optional[Callable] = None,
         name: str = "galvatron-prefetch",
+        stall_timeout: Optional[float] = None,
     ):
         if depth < 1:
             raise ValueError("prefetch depth must be >= 1, got %d" % depth)
@@ -66,6 +85,10 @@ class PrefetchIterator:
         self._exhausted = False
         self._error: Optional[BaseException] = None
         self._closed = False
+        self._stall_timeout = stall_timeout
+        self._produced = 0  # items the worker finished placing
+        self._consumed = 0  # items handed to the consumer
+        self._busy_since: Optional[float] = None  # worker inside next()/place_fn
         self._thread = threading.Thread(target=self._worker, name=name, daemon=True)
         self._thread.start()
 
@@ -83,29 +106,52 @@ class PrefetchIterator:
     def _worker(self):
         try:
             while not self._stop.is_set():
+                self._busy_since = time.monotonic()
                 try:
                     item = next(self._source)
                 except StopIteration:
+                    self._busy_since = None
                     self._put((_DONE, None))
                     return
                 if self._place_fn is not None:
                     item = self._place_fn(item)
+                self._busy_since = None
+                self._produced += 1
                 if not self._put((_ITEM, item)):
                     return
         except BaseException as e:  # noqa: BLE001 — relayed to the consumer
+            self._busy_since = None
             self._put((_ERROR, e))
 
     # ------------------------------------------------------------- consumer
     def __iter__(self):
         return self
 
-    def __next__(self):
+    def diagnostics(self) -> Dict:
+        """Producer-side state for the watchdog's stall report."""
+        busy = self._busy_since
+        return {
+            "worker_alive": self._thread.is_alive(),
+            "produced": self._produced,
+            "consumed": self._consumed,
+            "buffered": self._queue.qsize(),
+            "busy_for_s": (time.monotonic() - busy) if busy is not None else None,
+            "stall_timeout_s": self._stall_timeout,
+        }
+
+    def get(self, timeout: Optional[float] = None):
+        """Next placed batch, waiting at most `timeout` seconds (default:
+        the constructor's `stall_timeout`). A live worker that produces
+        nothing within the budget raises :class:`PrefetchStalledError`
+        with diagnostics instead of hanging the training thread."""
         if self._closed:
             raise RuntimeError("PrefetchIterator used after close()")
         if self._error is not None:
             raise self._error
         if self._exhausted:
             raise StopIteration
+        timeout = self._stall_timeout if timeout is None else timeout
+        deadline = (time.monotonic() + timeout) if timeout is not None else None
         while True:
             try:
                 tag, payload = self._queue.get(timeout=0.1)
@@ -115,8 +161,19 @@ class PrefetchIterator:
                     # happen; defensive against a killed interpreter)
                     self._exhausted = True
                     raise StopIteration
+                if deadline is not None and time.monotonic() > deadline:
+                    diag = self.diagnostics()
+                    raise PrefetchStalledError(
+                        "prefetch producer yielded nothing for %.1fs "
+                        "(worker alive, %d produced / %d buffered%s)"
+                        % (timeout, diag["produced"], diag["buffered"],
+                           ", busy in source/place_fn for %.1fs"
+                           % diag["busy_for_s"] if diag["busy_for_s"] else ""),
+                        diagnostics=diag,
+                    )
                 continue
             if tag == _ITEM:
+                self._consumed += 1
                 return payload
             if tag == _DONE:
                 self._exhausted = True
@@ -124,10 +181,16 @@ class PrefetchIterator:
             self._error = payload
             raise payload
 
+    def __next__(self):
+        return self.get()
+
     # ------------------------------------------------------------- shutdown
     def close(self, timeout: float = 5.0):
-        """Stop the worker and join it. Buffered batches are dropped (the
-        rollback path rebuilds the stream at a different step anyway)."""
+        """Stop the worker and join it (bounded). Buffered batches are
+        dropped (the rollback path rebuilds the stream at a different step
+        anyway). A worker wedged inside ``place_fn`` cannot be joined — the
+        bounded join returns anyway (daemon thread, cannot block exit) and
+        the leak is reported as a warning event rather than a deadlock."""
         if self._closed:
             return
         self._closed = True
@@ -139,6 +202,13 @@ class PrefetchIterator:
             except queue.Empty:
                 break
         self._thread.join(timeout=timeout)
+        if self._thread.is_alive():
+            from galvatron_tpu.obs import telemetry
+
+            telemetry.runtime_log(
+                "prefetch close: worker did not exit within %.1fs (wedged "
+                "in source/place_fn?); leaking the daemon thread" % timeout
+            )
 
     def __enter__(self):
         return self

@@ -34,6 +34,7 @@ roll back.
 from __future__ import annotations
 
 import dataclasses
+import random
 import signal
 import threading
 import time
@@ -56,6 +57,8 @@ class ResilienceCounters:
     anomalies_skipped: int = 0
     rollbacks: int = 0
     retries: int = 0
+    retries_succeeded: int = 0  # operations that failed, backed off, then made it
+    retries_exhausted: int = 0  # operations that gave up (budget or elapsed cap)
     emergency_saves: int = 0
     torn_checkpoints_skipped: int = 0
 
@@ -68,12 +71,24 @@ class ResilienceCounters:
 class RetryPolicy:
     """Exponential backoff for transient I/O failures (filesystem flakes,
     tensorstore timeouts). `retries` is the number of RE-attempts after the
-    first failure; delays are base * multiplier**attempt, capped."""
+    first failure; delays are base * multiplier**attempt, capped per-sleep
+    by `max_delay_s` and in TOTAL by `max_elapsed_s`.
+
+    `jitter` applies full jitter (delay drawn uniformly from [0, backoff])
+    — with many workers retrying the same flaky filesystem, synchronized
+    exponential backoff re-creates the thundering herd every 2^k seconds;
+    full jitter decorrelates them. `max_elapsed_s` bounds the whole retry
+    episode (sleeps + attempts measured on `clock`) so a restore-side retry
+    chain cannot outlive a preemption grace window: when the budget is
+    spent, the last error propagates immediately instead of sleeping into
+    the SIGKILL."""
 
     retries: int = 2
     base_delay_s: float = 0.5
     multiplier: float = 2.0
     max_delay_s: float = 8.0
+    max_elapsed_s: Optional[float] = None
+    jitter: bool = True
     retryable: Tuple[type, ...] = (OSError,)
 
 
@@ -84,23 +99,50 @@ def with_retry(
     description: str = "operation",
     sleep: Callable[[float], None] = time.sleep,
     log_fn: Callable[[str], None] = print,
+    rng: Callable[[], float] = random.random,
+    clock: Callable[[], float] = time.monotonic,
 ):
-    """Run `fn()`; on a retryable exception, back off exponentially and retry
-    up to `policy.retries` times. Non-retryable exceptions propagate
+    """Run `fn()`; on a retryable exception, back off (full jitter unless
+    the policy disables it) and retry up to `policy.retries` times within
+    `policy.max_elapsed_s` total. Non-retryable exceptions propagate
     immediately; the last retryable one propagates after the budget. Each
     backoff is logged through `log_fn` and recorded as a ``retry`` telemetry
-    event when a sink is active."""
+    event when a sink is active; `counters` distinguishes episodes that
+    eventually succeeded (`retries_succeeded`) from those that gave up
+    (`retries_exhausted`)."""
     from galvatron_tpu.obs import telemetry
 
     policy = policy or RetryPolicy()
     attempt = 0
+    t_start = clock()
     while True:
         try:
-            return fn()
+            out = fn()
+            if attempt > 0 and counters is not None:
+                counters.retries_succeeded += 1
+            return out
         except policy.retryable as e:
             if attempt >= policy.retries:
+                if counters is not None:
+                    counters.retries_exhausted += 1
                 raise
             delay = min(policy.base_delay_s * policy.multiplier**attempt, policy.max_delay_s)
+            if policy.jitter and delay > 0:
+                delay = rng() * delay
+            if policy.max_elapsed_s is not None and (
+                clock() - t_start + delay > policy.max_elapsed_s
+            ):
+                # sleeping would overrun the grace window — give up NOW with
+                # the real error, leaving the caller time to act on it
+                if counters is not None:
+                    counters.retries_exhausted += 1
+                log_fn(
+                    "resilience: %s failed (%s: %s); retry budget elapsed "
+                    "(%.2fs of %.2fs) — giving up"
+                    % (description, type(e).__name__, e, clock() - t_start,
+                       policy.max_elapsed_s)
+                )
+                raise
             if counters is not None:
                 counters.retries += 1
             log_fn(

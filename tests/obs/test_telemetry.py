@@ -30,6 +30,27 @@ def test_unknown_event_type_and_unknown_key_rejected():
         s.emit("eval", iter=1, split="valid")  # loss required
 
 
+def test_watchdog_and_migration_events_round_trip():
+    """The self-healing event surface: watchdog fire/escalate/prefetch_stall/
+    mesh_probe and the elastic migrate record (with full before/after
+    strategy JSON) are schema-valid at emit AND read."""
+    s = T.MemorySink()
+    s.emit("watchdog", action="fire", iter=7, phase="inflight", elapsed_s=3.2,
+           deadline_s=2.5, inflight_depth=2, last_drained=6, fires=1,
+           stacks="Thread 0x1 (most recent call first): ...")
+    s.emit("watchdog", action="prefetch_stall", iter=8, detail="no batch for 5s")
+    s.emit("watchdog", action="mesh_probe", iter=9, status="degraded",
+           expected=8, live=4, missing_ids=[4, 5, 6, 7])
+    s.emit("elastic", action="migrate", reason="sigusr1", iter=9,
+           saved_world=8, live_world=8, from_strategy={"pp_deg": 1},
+           to_strategy={"pp_deg": 2}, duration_ms=120.0, same_layout=False)
+    lines = [json.dumps(e) for e in s.events]
+    events, errors = T.read_events(lines)
+    assert errors == [] and len(events) == 4
+    with pytest.raises(T.TelemetryError, match="missing required"):
+        s.emit("watchdog", iter=1)  # action is required
+
+
 def test_none_optional_fields_are_dropped():
     s = T.MemorySink()
     e = s.emit("step", iter=3, loss=None, iter_ms=1.5)

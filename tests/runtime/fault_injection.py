@@ -19,6 +19,10 @@ Scenarios (``python -m tests.runtime.fault_injection --scenario ...``):
                    --kill_at; the process dies with -SIGKILL
     sigterm        the process sends itself SIGTERM at step --sigterm_at;
                    the loop must emergency-save and exit 0
+    hang           a sleeping callback inside the step at --hang_at stalls
+                   the run for --hang_s seconds; the watchdog (armed via
+                   --watchdog_floor/--watchdog_factor) must fire, escalate,
+                   emergency-save, and exit with WATCHDOG_EXIT_CODE (3)
 """
 
 from __future__ import annotations
@@ -94,6 +98,54 @@ def sigterm_hooks(at_step: int):
     def on_step(it: int):
         if it == at_step:
             os.kill(os.getpid(), signal.SIGTERM)
+
+    return FaultHooks(on_step=on_step)
+
+
+def hang_hooks(at_step: int, hang_s: float):
+    """FaultHooks wrapping the step function with a sleeping callback at
+    the `at_step`-th call: the step's result is computed and synced, then
+    the host sleeps inside the step call — from the driver's point of view
+    the step made no progress for `hang_s` seconds, exactly what a wedged
+    collective looks like to the watchdog (which cannot tell, and must not
+    care, WHERE inside the dispatch the time went)."""
+    import time as _time
+
+    from galvatron_tpu.runtime.resilience import FaultHooks
+
+    state = {"calls": 0}
+
+    def wrap(step_fn):
+        def wrapped(*a, **kw):
+            out = step_fn(*a, **kw)
+            if state["calls"] == at_step:
+                import jax
+
+                jax.block_until_ready(out)
+                _time.sleep(hang_s)
+            state["calls"] += 1
+            return out
+
+        return wrapped
+
+    return FaultHooks(wrap_step_fn=wrap)
+
+
+def sigusr1_hooks(at_step: int):
+    """FaultHooks sending THIS process SIGUSR1 ONCE at a step boundary —
+    the manual live-migration trigger (the driver re-plans for the live
+    world / --elastic_strategy and hot-swaps in memory). Once-guarded:
+    ``on_step`` re-fires for the same iteration whenever the loop re-enters
+    at a boundary (post-migration continue, eval, rollback), but a real
+    operator signal arrives once."""
+    from galvatron_tpu.runtime.resilience import FaultHooks
+
+    sent = {"done": False}
+
+    def on_step(it: int):
+        if it == at_step and not sent["done"]:
+            sent["done"] = True
+            os.kill(os.getpid(), signal.SIGUSR1)
 
     return FaultHooks(on_step=on_step)
 
@@ -186,13 +238,18 @@ def tiny_argv(train_iters: int, save=None, load=None, save_interval=0,
 def main(argv=None):
     p = argparse.ArgumentParser("fault_injection")
     p.add_argument("--scenario", required=True,
-                   choices=("train", "resume", "kill_mid_save", "sigterm"))
+                   choices=("train", "resume", "kill_mid_save", "sigterm", "hang"))
     p.add_argument("--save", default=None)
     p.add_argument("--load", default=None)
     p.add_argument("--iters", type=int, default=6)
     p.add_argument("--save_interval", type=int, default=0)
     p.add_argument("--kill_at", type=int, default=4)
     p.add_argument("--sigterm_at", type=int, default=2)
+    p.add_argument("--hang_at", type=int, default=4)
+    p.add_argument("--hang_s", type=float, default=6.0)
+    p.add_argument("--watchdog_floor", type=float, default=0.0,
+                   help="forwarded as --watchdog (0 keeps the watchdog off)")
+    p.add_argument("--watchdog_factor", type=float, default=2.0)
     p.add_argument("--devices", type=int, default=1,
                    help="virtual CPU device count for THIS process — the "
                         "hardware-loss simulation runs save and resume with "
@@ -215,7 +272,10 @@ def main(argv=None):
     from galvatron_tpu.cli.arguments import initialize_galvatron
     from galvatron_tpu.cli.train import train
 
-    extra = ["--elastic", a.elastic] if a.elastic else ()
+    extra = list(["--elastic", a.elastic] if a.elastic else [])
+    if a.watchdog_floor:
+        extra += ["--watchdog", str(a.watchdog_floor),
+                  "--watchdog_factor", str(a.watchdog_factor)]
     args = initialize_galvatron(mode="train_dist", argv=tiny_argv(
         a.iters, save=a.save, load=a.load, save_interval=a.save_interval,
         world=a.world, extra=extra))
@@ -223,6 +283,8 @@ def main(argv=None):
         arm_kill_before_manifest(a.kill_at)
     elif a.scenario == "sigterm":
         args.fault_hooks = sigterm_hooks(a.sigterm_at)
+    elif a.scenario == "hang":
+        args.fault_hooks = hang_hooks(a.hang_at, a.hang_s)
     try:
         summary = train(args)
     except Exception as e:
@@ -241,6 +303,15 @@ def main(argv=None):
     print("LOSSES=" + json.dumps(summary["losses"]))
     print("RESILIENCE=" + json.dumps(summary["resilience"]))
     print("INTERRUPTED=" + json.dumps(summary.get("interrupted")))
+    watchdog = summary.get("watchdog")
+    if watchdog is not None:
+        print("WATCHDOG=" + json.dumps(
+            {k: watchdog[k] for k in ("fires", "escalated")}))
+    if (watchdog or {}).get("escalated"):
+        # mirror cli.train.main's exit-code contract: the run self-evacuated
+        from galvatron_tpu.runtime.health import WATCHDOG_EXIT_CODE
+
+        return WATCHDOG_EXIT_CODE
     return 0
 
 

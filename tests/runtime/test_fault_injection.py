@@ -152,3 +152,45 @@ def test_elastic_resume_without_provenance_exits_2(tmp_path):
         expect_rc=2,
     )
     assert "GLS204" in proc.stderr
+
+
+def test_injected_hang_trips_watchdog_emergency_save_and_elastic_resume(tmp_path):
+    """The self-healing acceptance sim: a sleeping callback inside step 5
+    stalls the run for far longer than the learned deadline (floor 0.5s +
+    2 * median of the drained steps — by step 5's dispatch the in-flight
+    window of 2 has drained the >= 3 steps deadline learning needs). The
+    watchdog must fire, then escalate, the driver must emergency-save a
+    consistent state and exit with the distinct WATCHDOG_EXIT_CODE (3),
+    and the checkpoint must be intact and resumable via --elastic resume,
+    continuing the exact trajectory."""
+    from galvatron_tpu.runtime import checkpoint as ck
+    from galvatron_tpu.runtime.health import WATCHDOG_EXIT_CODE
+
+    d = str(tmp_path / "ck")
+    ref = run_scenario("--scenario", "train", "--iters", "8")
+    ref_losses = parse(ref.stdout, "LOSSES")
+
+    proc = run_scenario(
+        "--scenario", "hang", "--iters", "8", "--save", d,
+        "--hang_at", "5", "--hang_s", "8",
+        "--watchdog_floor", "0.5", "--watchdog_factor", "2",
+        expect_rc=WATCHDOG_EXIT_CODE, timeout=900,
+    )
+    assert parse(proc.stdout, "INTERRUPTED") == "watchdog"
+    wdog = parse(proc.stdout, "WATCHDOG")
+    assert wdog["escalated"] and wdog["fires"] >= 1
+    # the watchdog event stream carried the diagnostic dump
+    assert "watchdog fire" in proc.stdout or "watchdog escalate" in proc.stdout
+    # the emergency checkpoint committed its manifest (intact, not torn)
+    saved = ck.intact_iterations(d)
+    assert len(saved) == 1
+    k = saved[0]
+    assert k >= 5  # the hanging step itself completed before the exit
+    # the losses recorded before the evacuation match the reference
+    np.testing.assert_array_equal(parse(proc.stdout, "LOSSES"), ref_losses[:k])
+
+    resumed = run_scenario(
+        "--scenario", "resume", "--iters", "8", "--load", d,
+        "--elastic", "resume",
+    )
+    np.testing.assert_array_equal(parse(resumed.stdout, "LOSSES"), ref_losses[k:])

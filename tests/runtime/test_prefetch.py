@@ -8,7 +8,7 @@ import time
 
 import pytest
 
-from galvatron_tpu.runtime.prefetch import PrefetchIterator
+from galvatron_tpu.runtime.prefetch import PrefetchIterator, PrefetchStalledError
 
 
 def wait_until(pred, timeout=5.0):
@@ -126,3 +126,62 @@ def test_consumer_blocks_until_slow_producer_delivers():
 def test_depth_must_be_positive():
     with pytest.raises(ValueError):
         PrefetchIterator(iter([]), depth=0)
+
+
+# ----------------------------------------------------------- stall detection
+def _wedged_place(release: threading.Event):
+    def place(x):
+        release.wait(timeout=30.0)  # a device_put stuck on a sick link
+        return x
+
+    return place
+
+
+def test_get_times_out_on_wedged_place_fn_with_diagnostics():
+    release = threading.Event()
+    pf = PrefetchIterator(iter(range(3)), depth=2,
+                          place_fn=_wedged_place(release))
+    with pytest.raises(PrefetchStalledError) as exc:
+        pf.get(timeout=0.2)
+    diag = exc.value.diagnostics
+    assert diag["worker_alive"] is True
+    assert diag["produced"] == 0 and diag["buffered"] == 0
+    assert diag["busy_for_s"] is not None and diag["busy_for_s"] >= 0.2
+    release.set()  # unwedge: the stall was transient, the item arrives
+    assert pf.get(timeout=5.0) == 0
+    pf.close()
+
+
+def test_constructor_stall_timeout_applies_to_next():
+    release = threading.Event()
+    pf = PrefetchIterator(iter(range(3)), depth=2,
+                          place_fn=_wedged_place(release), stall_timeout=0.2)
+    with pytest.raises(PrefetchStalledError):
+        next(pf)
+    release.set()
+    pf.close()
+
+
+def test_no_timeout_waits_for_slow_producer():
+    """stall_timeout=None keeps the pre-watchdog semantics: block until
+    the (slow but live) producer delivers."""
+
+    def slow():
+        time.sleep(0.2)
+        yield 42
+
+    pf = PrefetchIterator(slow(), depth=1)
+    assert pf.get() == 42
+    pf.close()
+
+
+def test_close_under_stalled_producer_does_not_deadlock():
+    release = threading.Event()
+    pf = PrefetchIterator(iter(range(3)), depth=1,
+                          place_fn=_wedged_place(release))
+    time.sleep(0.05)  # let the worker get stuck inside place_fn
+    t0 = time.time()
+    pf.close(timeout=0.2)  # bounded join: returns despite the wedged worker
+    assert time.time() - t0 < 2.0
+    assert pf._closed
+    release.set()  # let the daemon thread unwind

@@ -77,20 +77,70 @@ def test_with_retry_backs_off_then_succeeds():
         return "done"
 
     out = rsl.with_retry(
-        flaky, rsl.RetryPolicy(retries=3, base_delay_s=0.1, multiplier=2.0),
+        flaky, rsl.RetryPolicy(retries=3, base_delay_s=0.1, multiplier=2.0,
+                               jitter=False),
         counters, sleep=delays.append,
     )
     assert out == "done"
     assert counters.retries == 2
+    assert counters.retries_succeeded == 1  # the episode eventually made it
+    assert counters.retries_exhausted == 0
     assert delays == [0.1, 0.2]  # exponential
 
 
+def test_with_retry_full_jitter_scales_backoff():
+    """Full jitter: each sleep is rng() * backoff, decorrelating the herd;
+    the rng seam keeps the test deterministic."""
+    delays = []
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise OSError("transient")
+        return "done"
+
+    rsl.with_retry(
+        flaky, rsl.RetryPolicy(retries=3, base_delay_s=1.0, multiplier=2.0),
+        sleep=delays.append, rng=lambda: 0.5,
+    )
+    assert delays == [0.5, 1.0]  # 0.5 * [1.0, 2.0]
+
+
+def test_with_retry_total_elapsed_cap():
+    """max_elapsed_s bounds the whole episode: when the next sleep would
+    overrun the grace window, the real error propagates immediately."""
+    counters = rsl.ResilienceCounters()
+    clock = {"t": 0.0}
+    slept = []
+
+    def sleep(d):
+        slept.append(d)
+        clock["t"] += d
+
+    with pytest.raises(OSError, match="always"):
+        rsl.with_retry(
+            lambda: (_ for _ in ()).throw(OSError("always")),
+            rsl.RetryPolicy(retries=10, base_delay_s=2.0, multiplier=1.0,
+                            jitter=False, max_elapsed_s=5.0),
+            counters, sleep=sleep, clock=lambda: clock["t"],
+        )
+    # 2s + 2s fit the 5s budget; the third 2s sleep would overrun it
+    assert slept == [2.0, 2.0]
+    assert counters.retries == 2
+    assert counters.retries_exhausted == 1
+    assert counters.retries_succeeded == 0
+
+
 def test_with_retry_exhausts_and_propagates():
+    counters = rsl.ResilienceCounters()
     with pytest.raises(OSError):
         rsl.with_retry(
             lambda: (_ for _ in ()).throw(OSError("always")),
             rsl.RetryPolicy(retries=2, base_delay_s=0.0), sleep=lambda _: None,
+            counters=counters,
         )
+    assert counters.retries_exhausted == 1 and counters.retries_succeeded == 0
     # non-retryable exceptions propagate immediately
     calls = {"n": 0}
 
@@ -358,5 +408,6 @@ def test_summary_reports_resilience_counters(devices8):
     s = run(["--train_iters", "2"])
     assert s["resilience"] == {
         "anomalies_skipped": 0, "rollbacks": 0, "retries": 0,
+        "retries_succeeded": 0, "retries_exhausted": 0,
         "emergency_saves": 0, "torn_checkpoints_skipped": 0,
     }
