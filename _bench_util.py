@@ -134,6 +134,10 @@ GATE_METRICS = (
     ("extra.train_step.mfu", True),
     ("extra.train_step.tokens_per_sec_per_chip", True),
     ("extra.train_loop.dispatch_ahead.steps_per_s", True),
+    # TP execution paths (ISSUE 8): the regression gate covers both the
+    # GSPMD baseline and the decomposed overlapped path
+    ("extra.tp_overlap.gspmd.step_ms", False),
+    ("extra.tp_overlap.overlap.step_ms", False),
 )
 
 

@@ -103,6 +103,31 @@ def _model_aware_diagnostics(hp: HybridParallelConfig, model_cfg: Any) -> List[D
     return out
 
 
+def _tp_comm_mode_diagnostics(hp: HybridParallelConfig, model_cfg: Any) -> List[D.Diagnostic]:
+    """GLS012: the manual shard_map TP path (tp_comm_mode != gspmd) refuses
+    configs it cannot express — report the refusal here, before any tracing,
+    with the same reason run_layers would raise with. Deduplicated by
+    reason; pp>1 is inert (GLS103), not refused, since the pipeline engines
+    keep the GSPMD path."""
+    out: List[D.Diagnostic] = []
+    if hp.tp_comm_mode == "gspmd" or hp.pp > 1:
+        return out
+    from galvatron_tpu.parallel.tp_shard_map import manual_tp_reason
+
+    seen = set()
+    for i, s in enumerate(hp.layers):
+        if s.tp <= 1:
+            continue
+        reason = manual_tp_reason(model_cfg, hp, s)
+        if reason and reason not in seen:
+            seen.add(reason)
+            out.append(D.make(
+                "GLS012", "layer %d: tp_comm_mode=%r refused: %s"
+                % (i, hp.tp_comm_mode, reason), layer=i, key="tp_comm_mode",
+            ))
+    return out
+
+
 # ----------------------------------------------------- cost-model warnings
 
 
@@ -261,6 +286,20 @@ def _warning_diagnostics(
                 "repurposes the tp axis)" % i, layer=i,
             ))
             break
+    if hp.tp_comm_mode != "gspmd":
+        if all(s.tp <= 1 for s in hp.layers):
+            out.append(D.make(
+                "GLS103", "tp_comm_mode=%r with tp=1 on every layer is "
+                "inert: there are no TP collectives to make visible or "
+                "overlap" % hp.tp_comm_mode, key="tp_comm_mode",
+            ))
+        elif hp.pp > 1:
+            out.append(D.make(
+                "GLS103", "tp_comm_mode=%r with pp=%d is inert: the "
+                "pipeline engines drive layer_forward directly and keep "
+                "the GSPMD TP path" % (hp.tp_comm_mode, hp.pp),
+                key="tp_comm_mode",
+            ))
     # GLS101: estimated memory vs budget
     if memory_budget_gb:
         stage_mb = estimate_stage_memory_mb(hp, model_cfg, memory_profile)
@@ -295,6 +334,7 @@ def lint_hp(
     report.extend(hp.pipeline_engine_diagnostics())
     if model_cfg is not None:
         report.extend(_model_aware_diagnostics(hp, model_cfg))
+    report.extend(_tp_comm_mode_diagnostics(hp, model_cfg))
     report.extend(_warning_diagnostics(hp, model_cfg, memory_budget_gb, memory_profile))
     if file:
         report.diagnostics = [

@@ -67,6 +67,16 @@ def _add_parallel_args(p: argparse.ArgumentParser):
                         "'full' remats everything (default), 'dots_saveable' "
                         "keeps matmul outputs resident, 'none' disables the "
                         "checkpoint flags entirely")
+    g.add_argument("--tp_comm_mode", type=str, default="gspmd",
+                   choices=("gspmd", "shard_map", "overlap"),
+                   help="TP-collective execution path for layer runs: "
+                        "'gspmd' lets the compiler infer the collectives "
+                        "(they serialize with the matmuls), 'shard_map' "
+                        "hand-writes them (visible, undecomposed), 'overlap' "
+                        "decomposes them into ppermute-pipelined chunked "
+                        "matmuls so communication hides behind compute "
+                        "(parallel/tp_shard_map.py; unsupported configs are "
+                        "refused with GLS012, never silently approximated)")
     g.add_argument("--galvatron_config_path", type=str, default=None,
                    help="searched per-layer strategy JSON; overrides the GLOBAL flags above")
     g.add_argument("--world_size", type=int, default=None, help="devices to use (default: all)")
@@ -362,6 +372,7 @@ def hp_config_from_args(args, num_layers: int, world_size: int):
     exec_kw = dict(
         scan_layers=getattr(args, "scan_layers", True),
         remat_policy=getattr(args, "remat_policy", "full"),
+        tp_comm_mode=getattr(args, "tp_comm_mode", "gspmd"),
     )
     if getattr(args, "galvatron_config_path", None):
         return HybridParallelConfig.from_json(

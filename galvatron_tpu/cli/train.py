@@ -271,6 +271,27 @@ def _train(args) -> dict:
     model = fam.build(cfg, hp) if fam.build else construct_hybrid_parallel_model(cfg, hp)
     tx, _sched = get_optimizer_and_scheduler(optimizer_args_from(args))
 
+    # Decomposed-TP overlap accounting: under tp_comm_mode=overlap, measure
+    # per TP LayerRun how much communication the chunked ppermute schedule
+    # hides (wall-clock of the run overlapped vs serialized —
+    # parallel/tp_shard_map.measure_comm_hidden). A one-off profiling pass
+    # (a couple of small per-run compiles), so it only runs when the run is
+    # being observed (--profile or --telemetry); recorded into the profiler
+    # summary (comm_hidden_ms, next to host_blocked_ms) and the telemetry
+    # stream (tp_overlap events the report lays beside the predictions).
+    comm_hidden_rows = []
+    if (hp.tp_comm_mode == "overlap" and hp.pp == 1 and not fam.build
+            and (args.profile or telemetry.active_sink() is not None)):
+        from galvatron_tpu.parallel import tp_shard_map as tp_sm
+
+        try:
+            comm_hidden_rows = tp_sm.measure_comm_hidden(cfg, hp, model.mesh)
+        except Exception as e:  # profiling must never kill the run
+            telemetry.runtime_log("tp overlap measurement skipped: %s" % e)
+            comm_hidden_rows = []
+        for row in comm_hidden_rows:
+            telemetry.emit("tp_overlap", mode=hp.tp_comm_mode, **row)
+
     params = model.init_params(jax.random.PRNGKey(args.seed))
     opt_state = model.init_opt_state(tx, params)
 
@@ -546,6 +567,8 @@ def _train(args) -> dict:
         model_flops=step_flops,
         peak_flops=peak_flops,
     )
+    for row in comm_hidden_rows:
+        prof.record_comm_hidden(row["run"], row["comm_hidden_ms"])
 
     preempt = None
     if getattr(args, "emergency_save", 0):

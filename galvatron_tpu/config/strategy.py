@@ -50,6 +50,14 @@ CP_MODES = ("ring", "zigzag")
 # matching jax.checkpoint_policies member (dots_saveable keeps matmul
 # outputs resident and remats only the cheap elementwise chains).
 REMAT_POLICIES = ("none", "full", "dots_saveable", "nothing_saveable")
+# TP-collective execution path for layer runs (models/base.run_layers —
+# parallel/tp_shard_map.py): "gspmd" leaves the collectives to the
+# compiler (they serialize with the matmuls), "shard_map" hand-writes them
+# (visible/schedulable, undecomposed), "overlap" decomposes them into
+# ppermute-pipelined chunked matmuls (ring all-gather / reduce-scatter
+# overlapped with compute, the ring_attention idiom on the dense kernels).
+# A runtime knob like remat_policy: NOT serialized into the strategy JSON.
+TP_COMM_MODES = ("gspmd", "shard_map", "overlap")
 
 # The reference-compatible on-disk schema (from_json/to_json_dict). Split by
 # shape so the schema linter can check lengths/types uniformly.
@@ -242,6 +250,7 @@ class HybridParallelConfig:
     scan_layers: bool = True  # stack same-strategy layer runs into lax.scan
     # (depth-constant trace/compile cost); False = unroll every layer
     remat_policy: str = "full"  # REMAT_POLICIES: policy for checkpoint=1 layers
+    tp_comm_mode: str = "gspmd"  # TP_COMM_MODES: TP-collective execution path
 
     def __post_init__(self):
         if self.pp_division is None:
@@ -278,6 +287,12 @@ class HybridParallelConfig:
                 "GLS005", "remat_policy must be one of %s, got %r"
                 % (REMAT_POLICIES, self.remat_policy), key="remat_policy",
                 hint=D.did_you_mean(str(self.remat_policy), REMAT_POLICIES),
+            ))
+        if self.tp_comm_mode not in TP_COMM_MODES:
+            out.append(D.make(
+                "GLS005", "tp_comm_mode must be one of %s, got %r"
+                % (TP_COMM_MODES, self.tp_comm_mode), key="tp_comm_mode",
+                hint=D.did_you_mean(str(self.tp_comm_mode), TP_COMM_MODES),
             ))
         if self.pp < 1 or self.world_size % self.pp != 0:
             out.append(D.make(

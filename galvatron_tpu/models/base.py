@@ -433,6 +433,25 @@ def vocab_parallel_cross_entropy(logits: jax.Array, labels: jax.Array,
 
 
 # ------------------------------------------------- scan-over-layer-runs
+def _layer_fwd_fn(cfg, hp, mesh, axes, attn_bias, strategy):
+    """The per-layer forward for one run: the GSPMD `layer_forward` by
+    default; under ``tp_comm_mode in (shard_map, overlap)`` the manual
+    shard_map path (parallel/tp_shard_map.py) for layers that actually have
+    TP collectives — refusing loudly (GLS012) on configs it cannot express.
+    tp=1 layers have no TP collectives and compile to the identical GSPMD
+    program either way (the linter warns that the knob is inert)."""
+    from galvatron_tpu.parallel import tp_shard_map as T
+
+    if T.wants_manual_tp(hp, axes):
+        # refusal is per-run at trace time; the train driver's lint_hp pass
+        # reports the same GLS012 before any tracing
+        T.assert_manual_tp_supported(cfg, hp, strategy)
+        return partial(T.manual_layer_forward, cfg=cfg, mesh=mesh, axes=axes,
+                       hp=hp, attn_bias=attn_bias, mode=hp.tp_comm_mode)
+    return partial(layer_forward, cfg=cfg, mesh=mesh, axes=axes,
+                   attn_bias=attn_bias)
+
+
 def _remat(fn, policy: str):
     """jax.checkpoint with the configured saveable policy. "full" (and the
     caller-filtered "none") is jax.checkpoint's default — save nothing,
@@ -503,7 +522,8 @@ def run_layers(
             axes = layer_axes(hp, i) if use_hp else None
             if use_hp:
                 x = S.constrain(x, mesh, S.act_spec(axes))
-            fwd = partial(layer_forward, cfg=cfg, mesh=mesh, axes=axes, attn_bias=attn_bias)
+            fwd = _layer_fwd_fn(cfg, hp if use_hp else None, mesh, axes,
+                                attn_bias, hp.layers[i] if use_hp else None)
             if use_hp and hp.layers[i].checkpoint and policy != "none":
                 fwd = _remat(fwd, policy)
             x = fwd(lp, x, positions)
@@ -525,7 +545,8 @@ def run_layers(
                 lambda t, sp: S.constrain(t, mesh, sp),
                 stacked, stacked_layer_param_specs(cfg, axes),
             )
-        body = partial(layer_forward, cfg=cfg, mesh=mesh, axes=axes, attn_bias=attn_bias)
+        body = _layer_fwd_fn(cfg, hp if use_hp else None, mesh, axes,
+                             attn_bias, run.strategy if use_hp else None)
         if use_hp and run.strategy.checkpoint and policy != "none":
             body = _remat(body, policy)
 

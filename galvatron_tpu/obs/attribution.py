@@ -140,15 +140,28 @@ def predict_layer_runs(
     runs = layer_runs(hp)
     run_flops = F.run_fwd_flops(cfg, hp)  # len(runs)+1 (head), or None
     total_flops = sum(run_flops) if run_flops else None
+    tp_comm_mode = getattr(hp, "tp_comm_mode", "gspmd")
 
     out: List[Dict[str, Any]] = []
     for idx, run in enumerate(runs):
         strategy = strategy_as_list(run.strategy, hp, run.start)
-        per_layer_ms = TimeCostModel(
+        tcm = TimeCostModel(
             strategy, global_batch_size=hp.global_bsz,
             model_args=ma, train_args=ta, parallel_args=pa,
             profile_model_args=pma, profile_hardware_args=pha,
-        ).gen_result()
+        )
+        per_layer_ms = tcm.gen_result()
+        # the TP-collective share of the layer, priced on the same scale as
+        # gen_result — the term tp_comm_mode=overlap can hide behind the
+        # chunked matmul schedule (bounded by the compute it overlaps with,
+        # the T3 perfect-overlap model)
+        scale = pha.costmodel_coe / tcm.layer_num
+        per_layer_comm_ms = tcm.tp_communication_time * scale
+        per_layer_hidden_ms = 0.0
+        if tp_comm_mode == "overlap" and run.strategy.tp > 1:
+            per_layer_hidden_ms = min(per_layer_comm_ms,
+                                      (tcm.fct + tcm.bct) * scale)
+            per_layer_ms -= per_layer_hidden_ms
         per_layer_mb = MemoryCostModel(
             strategy, global_batch_size=hp.global_bsz,
             mbsz=max(1, hp.global_bsz // max(1, hp.chunks)),
@@ -163,6 +176,12 @@ def predict_layer_runs(
             "predicted_ms": round(per_layer_ms * run.length, 4),
             "predicted_memory_mb": round(per_layer_mb * run.length, 2),
         }
+        if run.strategy.tp > 1:
+            entry["tp_comm_mode"] = tp_comm_mode
+            entry["predicted_comm_ms"] = round(per_layer_comm_ms * run.length, 4)
+            if tp_comm_mode == "overlap":
+                entry["predicted_comm_hidden_ms"] = round(
+                    per_layer_hidden_ms * run.length, 4)
         if run_flops is not None:
             entry["flops"] = run_flops[idx]
             entry["flops_share"] = round(run_flops[idx] / total_flops, 6)
@@ -193,7 +212,8 @@ def divergence_rows(
     for p in predictions:
         row = {k: p.get(k) for k in (
             "run", "start", "stop", "strategy", "predicted_ms",
-            "predicted_memory_mb", "flops_share",
+            "predicted_memory_mb", "flops_share", "tp_comm_mode",
+            "predicted_comm_ms", "predicted_comm_hidden_ms",
         )}
         share = p.get("flops_share")
         if measured_step_ms is not None and share is not None:
@@ -213,14 +233,19 @@ def render_divergence_table(rows: List[Dict[str, Any]]) -> str:
     human rendering)."""
     if not rows:
         return "(no layer-run predictions recorded)"
+    # the comm columns only render when some run priced a TP-collective
+    # path (tp>1); dp-only tables keep the original width
+    has_comm = any(r.get("predicted_comm_ms") is not None for r in rows)
     header = ("run", "layers", "strategy", "pred_ms", "meas_ms", "ratio",
               "pred_mb", "share")
+    if has_comm:
+        header += ("comm_ms", "hid_ms")
     body = []
     for r in rows:
         run = r.get("run")
         layers = ("%d-%d" % (r["start"], r["stop"] - 1)
                   if r.get("stop") and r["stop"] > r.get("start", 0) else "-")
-        body.append((
+        cells = (
             "head" if run == HEAD_RUN else str(run),
             layers,
             str(r.get("strategy") or "-"),
@@ -229,7 +254,11 @@ def render_divergence_table(rows: List[Dict[str, Any]]) -> str:
             _fmt(r.get("time_ratio")),
             _fmt(r.get("predicted_memory_mb")),
             _fmt(r.get("flops_share")),
-        ))
+        )
+        if has_comm:
+            cells += (_fmt(r.get("predicted_comm_ms")),
+                      _fmt(r.get("predicted_comm_hidden_ms")))
+        body.append(cells)
     widths = [max(len(header[i]), *(len(b[i]) for b in body)) for i in range(len(header))]
     lines = ["  ".join(h.ljust(w) for h, w in zip(header, widths))]
     lines.append("  ".join("-" * w for w in widths))

@@ -113,6 +113,18 @@ def analyze(
         measured_step_ms=steady.get("step_ms"),
         measured_memory_mb=compile_ev.get("compiled_memory_mb"),
     ) if predictions else []
+    # measured overlap (tp_shard_map.measure_comm_hidden): lay the measured
+    # hidden-comm number beside the prediction's row for the same run
+    overlap_events = [
+        {k: v for k, v in e.items() if k not in ("v", "t", "seq", "type")}
+        for e in by_type.get("tp_overlap", [])
+    ]
+    if overlap_events and divergence:
+        by_run = {e.get("run"): e for e in overlap_events}
+        for row in divergence:
+            ev = by_run.get(row.get("run"))
+            if ev is not None and ev.get("comm_hidden_ms") is not None:
+                row["comm_hidden_ms"] = ev["comm_hidden_ms"]
 
     timeline = [
         {k: v for k, v in e.items() if k not in ("v",) + _TIMELINE_ELIDED_KEYS}
@@ -158,6 +170,7 @@ def analyze(
                 if e.get("action") == "migrate"),
         },
         "divergence": divergence,
+        "tp_overlap": overlap_events,
         "timeline": timeline,
     }
     run_end = by_type.get("run_end")
@@ -218,6 +231,18 @@ def render(analysis: Dict[str, Any]) -> str:
     lines.append("")
     lines.append("predicted vs measured per layer run:")
     lines.append(A.render_divergence_table(analysis["divergence"]))
+    if analysis.get("tp_overlap"):
+        lines.append("")
+        lines.append("TP overlap (decomposed collectives, measured):")
+        for e in analysis["tp_overlap"]:
+            lines.append(
+                "  run %s (layers %s-%s): overlap %s ms vs serialized %s ms "
+                "-> comm hidden %s ms"
+                % (_fmt(e.get("run")), _fmt(e.get("start")),
+                   _fmt(e.get("stop", 1) - 1 if e.get("stop") is not None else None),
+                   _fmt(e.get("overlap_ms")), _fmt(e.get("serial_ms")),
+                   _fmt(e.get("comm_hidden_ms")))
+            )
     if analysis["timeline"]:
         lines.append("")
         lines.append("lifecycle timeline:")

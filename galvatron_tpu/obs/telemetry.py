@@ -110,7 +110,18 @@ EVENT_SCHEMAS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
     "layer_run": (
         ("run", "start", "stop"),
         ("strategy", "predicted_ms", "predicted_memory_mb", "flops",
-         "flops_share"),
+         "flops_share", "tp_comm_mode", "predicted_comm_ms",
+         "predicted_comm_hidden_ms"),
+    ),
+    # measured compute/collective overlap of the decomposed TP path
+    # (parallel/tp_shard_map.measure_comm_hidden): per TP LayerRun, the
+    # wall-clock of the run under the overlapped schedule vs the serialized
+    # manual schedule — comm_hidden_ms is the communication the chunked
+    # ppermute pipeline moved off the critical path
+    "tp_overlap": (
+        ("run",),
+        ("start", "stop", "mode", "overlap_ms", "serial_ms",
+         "comm_hidden_ms"),
     ),
     # jax.profiler start/stop_trace bracketing (--xla_trace)
     "trace": (("action",), ("dir", "first_step", "last_step", "error")),

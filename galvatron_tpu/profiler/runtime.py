@@ -102,6 +102,12 @@ class RuntimeProfiler:
     model_flops: Optional[float] = None  # model FLOPs per optimizer step
     peak_flops: Optional[float] = None  # device peak FLOP/s (registry)
     compiled_memory_mb: Optional[float] = None  # compiled-step working set
+    # decomposed-TP overlap accounting (parallel/tp_shard_map): per-LayerRun
+    # measured comm hidden behind the chunked matmul schedule; the summary
+    # reports the per-step total next to host_blocked_ms — one is the comm
+    # the overlap path hid on-device, the other the host-side stall the
+    # dispatch-ahead loop hides
+    comm_hidden_ms: Dict[int, float] = field(default_factory=dict)
     _iter: int = 0
     _log_fh = None  # one appending handle for the whole run (close() closes)
 
@@ -152,6 +158,13 @@ class RuntimeProfiler:
             jax.block_until_ready(outputs)
         if self._wall_t0 is not None and self._started > 0:
             self.loop_wall_ms = (time.perf_counter() - self._wall_t0) * 1e3
+
+    def record_comm_hidden(self, run: int, hidden_ms: float):
+        """Record the measured communication time (ms per step) the
+        decomposed TP path hid behind chunked compute for one LayerRun
+        (tp_shard_map.measure_comm_hidden; driver --profile under
+        tp_comm_mode=overlap)."""
+        self.comm_hidden_ms[int(run)] = float(hidden_ms)
 
     def record_compile(self, trace_ms: Optional[float] = None,
                        compile_ms: Optional[float] = None):
@@ -208,6 +221,8 @@ class RuntimeProfiler:
             out["wall_ms_per_iter"] = self.loop_wall_ms / self._started
             if self.loop_wall_ms > 0:
                 out["steps_per_s"] = self._started / (self.loop_wall_ms / 1e3)
+        if self.comm_hidden_ms:
+            out["comm_hidden_ms"] = float(sum(self.comm_hidden_ms.values()))
         if self.trace_ms is not None:
             out["trace_ms"] = self.trace_ms
         if self.compile_ms is not None:

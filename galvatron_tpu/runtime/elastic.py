@@ -363,6 +363,7 @@ def resolve_resume_strategy(
     exec_kw = dict(
         scan_layers=getattr(args, "scan_layers", True),
         remat_policy=getattr(args, "remat_policy", "full"),
+        tp_comm_mode=getattr(args, "tp_comm_mode", "gspmd"),
         mixed_precision=getattr(args, "mixed_precision", "bf16"),
     )
     saved_hp = HybridParallelConfig.from_json(
@@ -472,6 +473,7 @@ def resolve_migration_strategy(
     exec_kw = dict(
         scan_layers=current_hp.scan_layers,
         remat_policy=current_hp.remat_policy,
+        tp_comm_mode=current_hp.tp_comm_mode,
         mixed_precision=current_hp.mixed_precision,
     )
     budget = getattr(args, "elastic_memory_gb", None) or DEFAULT_MEMORY_GB

@@ -106,6 +106,44 @@ def test_compilation_flags_default_and_plumbing(tmp_path):
     hp.assert_equal(ref)  # execution knobs don't change strategy identity
 
 
+def test_tp_comm_mode_flag_plumbing(tmp_path):
+    """--tp_comm_mode reaches HybridParallelConfig on both the GLOBAL-flags
+    path and the searched-JSON path, and (like remat_policy) is never
+    serialized into the on-disk strategy schema."""
+    args = initialize_galvatron(mode="train_dist", argv=[])
+    assert args.tp_comm_mode == "gspmd"
+    hp = hp_config_from_args(args, num_layers=2, world_size=8)
+    assert hp.tp_comm_mode == "gspmd"
+
+    args = initialize_galvatron(mode="train_dist", argv=[
+        "--global_tp_deg", "2", "--tp_comm_mode", "overlap",
+    ])
+    hp = hp_config_from_args(args, num_layers=2, world_size=8)
+    assert hp.tp_comm_mode == "overlap"
+    assert "tp_comm_mode" not in hp.to_json_dict()
+
+    from galvatron_tpu.config.strategy import HybridParallelConfig
+
+    ref = HybridParallelConfig.uniform(world_size=8, num_layers=2, tp=2, global_bsz=8)
+    p = tmp_path / "strategy.json"
+    ref.save(str(p))
+    args = initialize_galvatron(mode="train_dist", argv=[
+        "--galvatron_config_path", str(p), "--tp_comm_mode", "shard_map",
+        "--global_train_batch_size", "8",
+    ])
+    hp = hp_config_from_args(args, num_layers=2, world_size=8)
+    assert hp.tp_comm_mode == "shard_map"
+    hp.assert_equal(ref)  # the knob doesn't change strategy identity
+
+
+def test_tp_comm_mode_validated():
+    from galvatron_tpu.analysis.diagnostics import DiagnosticError
+    from galvatron_tpu.config.strategy import HybridParallelConfig
+
+    with pytest.raises(DiagnosticError, match="GLS005"):
+        HybridParallelConfig.uniform(8, 2, tp_comm_mode="bogus")
+
+
 def test_persistent_compile_cache_opt_in(tmp_path):
     """enable_persistent_cache points jax at the requested dir (created if
     missing). EVERY touched config knob is restored afterwards: leaking the

@@ -30,16 +30,12 @@ from galvatron_tpu.runtime.optimizer import OptimizerArgs, get_optimizer_and_sch
 
 
 @pytest.fixture(autouse=True)
-def _no_persistent_compile_cache():
+def _no_persistent_compile_cache(disable_persistent_compile_cache):
     """This module compiles full-size train steps via PLAIN jit (no driver,
-    so no _STEP_EXECUTABLES bypass). A >1s step compile lands in the
-    session's persistent cache and the next identical compile would execute
-    a DESERIALIZED XLA:CPU executable — the known heap-corruption hazard
-    (tests/conftest.py). Cache off for the module; the knob is restored."""
-    prev = jax.config.jax_compilation_cache_dir
-    jax.config.update("jax_compilation_cache_dir", None)
+    so no _STEP_EXECUTABLES bypass) — the shared conftest guard keeps those
+    compiles out of the session's persistent cache (deserialized-executable
+    heap corruption, see tests/conftest.py)."""
     yield
-    jax.config.update("jax_compilation_cache_dir", prev)
 
 
 def tiny_cfg(**kw):

@@ -46,6 +46,42 @@ def test_losses_match_across_strategies(devices8):
     np.testing.assert_allclose(a["losses"], b["losses"], rtol=5e-3, atol=2e-4)
 
 
+def test_train_tp_comm_mode_overlap_driver_parity_and_telemetry(devices8, tmp_path):
+    """ISSUE 8 driver-level wiring: --tp_comm_mode overlap trains the same
+    trajectory as the GSPMD default, the overlap measurement runs under
+    --profile/--telemetry (tp_overlap events, summary comm_hidden_ms), and
+    the stream stays schema-valid."""
+    from galvatron_tpu.obs import telemetry as T
+
+    base = ["--world_size", "8", "--global_tp_deg", "2"]
+    ref = run(base)
+    tele = str(tmp_path / "tp.jsonl")
+    s = run(base + ["--tp_comm_mode", "overlap", "--profile", "1",
+                    "--telemetry", tele])
+    np.testing.assert_allclose(s["losses"], ref["losses"], rtol=1e-5, atol=1e-6)
+    assert s.get("comm_hidden_ms") is not None and s["comm_hidden_ms"] >= 0
+    events, errors = T.read_events(tele)
+    assert errors == []
+    overlap_events = [e for e in events if e["type"] == "tp_overlap"]
+    assert len(overlap_events) == 1
+    ev = overlap_events[0]
+    assert ev["mode"] == "overlap" and (ev["start"], ev["stop"]) == (0, 2)
+    assert ev["overlap_ms"] > 0 and ev["serial_ms"] > 0
+    # layer_run predictions price the overlapped path
+    lr = [e for e in events if e["type"] == "layer_run" and e["run"] != -1]
+    assert lr and all(e.get("tp_comm_mode") == "overlap" for e in lr)
+
+
+def test_train_tp_comm_mode_refusal_exits_via_lint(devices8):
+    """An unsupported manual-path config is refused by the driver's lint
+    pass BEFORE any tracing (GLS012 DiagnosticError)."""
+    from galvatron_tpu.analysis.diagnostics import DiagnosticError
+
+    with pytest.raises(DiagnosticError, match="GLS012"):
+        run(["--world_size", "8", "--global_tp_deg", "2", "--use-ulysses",
+             "--tp_comm_mode", "shard_map"])
+
+
 def test_checkpoint_save_resume(devices8, tmp_path):
     full = run(["--world_size", "8", "--train_iters", "4"])
     ck = str(tmp_path / "ck")

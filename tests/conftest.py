@@ -53,6 +53,22 @@ atexit.register(shutil.rmtree, _cache_dir, ignore_errors=True)
 jax.config.update("jax_compilation_cache_dir", _cache_dir)
 
 
+@pytest.fixture
+def disable_persistent_compile_cache():
+    """Module-shareable guard against the jaxlib 0.4.37 deserialized-
+    executable heap corruption (the KNOWN HAZARD above): any module that
+    compiles >1s programs via PLAIN jit which can recur identically within
+    the session (full-size train steps, the shard_map TP parity matrix) must
+    keep those compiles out of the session's persistent cache — the second
+    identical compile would otherwise EXECUTE A DESERIALIZED XLA:CPU
+    executable. Use as `pytest.mark.usefixtures(...)` via an autouse wrapper
+    or pytestmark; the knob is restored afterwards."""
+    prev = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", None)
+    yield
+    jax.config.update("jax_compilation_cache_dir", prev)
+
+
 @pytest.fixture(scope="session")
 def devices8():
     devs = jax.devices()

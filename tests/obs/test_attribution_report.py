@@ -47,6 +47,60 @@ def test_predict_layer_runs_covers_every_run():
     assert sum(p["flops_share"] for p in preds) == pytest.approx(1.0, abs=1e-3)
 
 
+def test_predict_layer_runs_prices_tp_comm_and_overlap():
+    """ISSUE 8: tp>1 runs carry the TP-collective share of the prediction;
+    under tp_comm_mode=overlap the hidden fraction (bounded by the compute
+    it overlaps) is discounted from predicted_ms — the T3 perfect-overlap
+    model — and every extended row is still a schema-valid layer_run event."""
+    cfg = tiny_cfg()
+    base = hetero_hp()
+    preds = {}
+    for mode in ("gspmd", "overlap"):
+        hp = HybridParallelConfig(
+            world_size=8, pp=1, layers=list(base.layers), global_bsz=8,
+            tp_comm_mode=mode)
+        preds[mode] = A.predict_layer_runs(cfg, hp)
+    tp_row = {m: p[0] for m, p in preds.items()}
+    dp_row = {m: p[1] for m, p in preds.items()}
+    # the tp run prices its collectives; the tp=1 run has none to price
+    assert tp_row["gspmd"]["predicted_comm_ms"] > 0
+    assert tp_row["gspmd"]["tp_comm_mode"] == "gspmd"
+    assert "predicted_comm_hidden_ms" not in tp_row["gspmd"]
+    assert "predicted_comm_ms" not in dp_row["gspmd"]
+    hidden = tp_row["overlap"]["predicted_comm_hidden_ms"]
+    assert 0 < hidden <= tp_row["overlap"]["predicted_comm_ms"] + 1e-9
+    assert tp_row["overlap"]["predicted_ms"] == pytest.approx(
+        tp_row["gspmd"]["predicted_ms"] - hidden, rel=1e-6)
+    sink = T.MemorySink()
+    for p in preds["overlap"]:
+        sink.emit("layer_run", **p)
+    # the comm columns surface in the rendered table only when priced
+    rows = A.divergence_rows(preds["overlap"], measured_step_ms=100.0)
+    table = A.render_divergence_table(rows)
+    assert "comm_ms" in table and "hid_ms" in table
+    plain = A.render_divergence_table(
+        A.divergence_rows(
+            A.predict_layer_runs(
+                cfg, HybridParallelConfig.uniform(8, 4, global_bsz=8)),
+            measured_step_ms=100.0))
+    assert "comm_ms" not in plain
+
+
+def test_report_surfaces_tp_overlap_events():
+    """The golden stream's tp_overlap event lands in the analysis, joins
+    the matching divergence row, and renders."""
+    events, errors = T.read_events(GOLDEN)
+    assert errors == []
+    analysis = R.analyze(events)
+    assert len(analysis["tp_overlap"]) == 1
+    ev = analysis["tp_overlap"][0]
+    assert ev["run"] == 0 and ev["comm_hidden_ms"] == pytest.approx(3.5)
+    row0 = [r for r in analysis["divergence"] if r.get("run") == 0][0]
+    assert row0["comm_hidden_ms"] == pytest.approx(3.5)
+    text = R.render(analysis)
+    assert "TP overlap" in text and "comm hidden" in text
+
+
 def test_divergence_rows_split_measured_step_by_share():
     cfg, hp = tiny_cfg(), hetero_hp()
     preds = A.predict_layer_runs(cfg, hp)

@@ -67,16 +67,20 @@ def test_mfu_regression_gate_exit_codes(tmp_path):
     chip, milliseconds."""
     baseline = {"n": 3, "parsed": {
         "metric": "gpt_layer_fwd_ms_per_layer_per_sample_h4096_s2048_bf16",
-        "value": 5.0, "extra": {"train_step": {"mfu": 0.4,
-                                               "tokens_per_sec_per_chip": 30000.0}}}}
+        "value": 5.0, "extra": {
+            "train_step": {"mfu": 0.4, "tokens_per_sec_per_chip": 30000.0},
+            "tp_overlap": {"gspmd": {"step_ms": 10.0},
+                           "overlap": {"step_ms": 9.0}}}}}
     empty_round = {"n": 4, "parsed": None}  # wedged round: tolerated, skipped
     (tmp_path / "BENCH_r03.json").write_text(json.dumps(baseline))
     (tmp_path / "BENCH_r04.json").write_text(json.dumps(empty_round))
 
-    def run_gate(mfu, gate="1"):
+    def run_gate(mfu, gate="1", overlap_step_ms=9.0):
         fake = tmp_path / "fake.json"
-        fake.write_text(json.dumps({"results": {"train_step": {
-            "mfu": mfu, "tokens_per_sec_per_chip": 30000.0}}}))
+        fake.write_text(json.dumps({"results": {
+            "train_step": {"mfu": mfu, "tokens_per_sec_per_chip": 30000.0},
+            "tp_overlap": {"gspmd": {"step_ms": 10.0},
+                           "overlap": {"step_ms": overlap_step_ms}}}}))
         env = dict(os.environ,
                    GALVATRON_BENCH_FAKE_RESULTS=str(fake),
                    GALVATRON_BENCH_GATE=gate,
@@ -89,6 +93,11 @@ def test_mfu_regression_gate_exit_codes(tmp_path):
     assert "MFU-REGRESSION" in p.stdout and "train_step.mfu" in p.stdout
     p = run_gate(0.39)  # -2.5%: within the 10% tolerance
     assert p.returncode == 0, p.stdout
+    # the gate covers the decomposed-TP path too (ISSUE 8): a slower
+    # overlap step is a regression even with MFU healthy
+    p = run_gate(0.4, overlap_step_ms=15.0)
+    assert p.returncode == 1, p.stdout
+    assert "tp_overlap.overlap.step_ms" in p.stdout
     p = run_gate(0.2, gate="")  # gate off: wedge-proofing contract holds
     assert p.returncode == 0 and "MFU-REGRESSION" not in p.stdout
     # no usable baseline at all: tolerated
