@@ -138,6 +138,12 @@ GATE_METRICS = (
     # GSPMD baseline and the decomposed overlapped path
     ("extra.tp_overlap.gspmd.step_ms", False),
     ("extra.tp_overlap.overlap.step_ms", False),
+    # Quantized collectives (ISSUE 9): the gate pins both the fp32 baseline
+    # and the int8 grad-sync step so neither path silently decays — and the
+    # loss delta so quantization error cannot silently grow either
+    ("extra.quant_comm.fp32.step_ms", False),
+    ("extra.quant_comm.int8.step_ms", False),
+    ("extra.quant_comm.loss_delta_int8", False),
 )
 
 

@@ -620,6 +620,88 @@ def section_tp_overlap():
     return out
 
 
+def section_quant_comm():
+    """Quantized collectives (ISSUE 9): fp32 vs int8 gradient sync (ddp) and
+    fp32 vs int8 ZeRO-3 gather+sync on the multi-virtual-device CPU config —
+    the full train step through make_train_step, which is where the explicit
+    shard_map grad ring lives (parallel/quant_collectives.py). Reports per
+    mode step_ms/trace_ms/compile_ms + the final short-run loss, plus the
+    bytes-on-wire estimate and the fp32-vs-int8 loss delta. On CPU the ring
+    is python-unrolled scalar work, so int8 showing no speedup is expected —
+    the numbers exist so the regression gate pins them and the first
+    real-silicon round has a baseline shape to fill in."""
+    import numpy as np
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import optax
+
+    from galvatron_tpu.config.strategy import HybridParallelConfig
+    from galvatron_tpu.models import base as M
+    from galvatron_tpu.parallel import quant_collectives as QC
+    from galvatron_tpu.runtime.dataloader import get_train_iterator
+    from galvatron_tpu.runtime.model_api import construct_hybrid_parallel_model
+
+    S_, H_, NL, BSZ = (32, 32, 2, 8) if SMOKE else (64, 64, 2, 8)
+    steps = 4 if SMOKE else 8
+    cfg = M.TransformerConfig(
+        hidden_size=H_, num_heads=4, num_layers=NL, vocab_size=256,
+        max_seq_len=S_, compute_dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    modes = {
+        "fp32": dict(sdp=0, grad_comm_dtype="none", param_comm_dtype="none"),
+        "int8": dict(sdp=0, grad_comm_dtype="int8", param_comm_dtype="none"),
+        "zero3_fp32": dict(sdp=1, grad_comm_dtype="none", param_comm_dtype="none"),
+        "zero3_int8": dict(sdp=1, grad_comm_dtype="int8", param_comm_dtype="int8"),
+    }
+    out = {"world": 4, "layers": NL, "seq": S_, "global_bsz": BSZ,
+           "train_steps": steps}
+    finals = {}
+    for name, kw in modes.items():
+        hp = HybridParallelConfig.uniform(
+            4, NL, tp=1, global_bsz=BSZ, mixed_precision="fp32", **kw)
+        model = construct_hybrid_parallel_model(cfg, hp)
+        tx = optax.adam(1e-3)
+        params = model.init_params(jax.random.PRNGKey(0))
+        opt_state = model.init_opt_state(tx, params)
+        step = model.make_train_step(tx, donate=False)
+        it = get_train_iterator(hp, cfg.vocab_size, cfg.max_seq_len, seed=1)
+        batches = [model.shard_batch(next(it)) for _ in range(steps)]
+        t0 = time.perf_counter()
+        params, opt_state, m = step(params, opt_state, batches[0])
+        jax.block_until_ready(m["loss"])
+        build_ms = (time.perf_counter() - t0) * 1e3  # trace+compile+1st step
+        losses, times = [float(m["loss"])], []
+        for b in batches[1:]:
+            t0 = time.perf_counter()
+            params, opt_state, m = step(params, opt_state, b)
+            jax.block_until_ready(m["loss"])
+            times.append(time.perf_counter() - t0)
+            losses.append(float(m["loss"]))
+        finals[name] = losses[-1]
+        entry = {
+            "step_ms": round(float(np.median(times)) * 1e3, 3),
+            "build_ms": round(build_ms, 1),
+            "final_loss": round(losses[-1], 6),
+        }
+        from galvatron_tpu.analysis.strategy_lint import _analytic_parameter_mb
+
+        pmb = _analytic_parameter_mb(cfg)
+        if pmb:
+            entry["wire_mb"] = QC.bytes_on_wire_mb(hp, pmb)["configured"]
+        out[name] = entry
+    out["loss_delta_int8"] = round(abs(finals["int8"] - finals["fp32"]), 6)
+    out["loss_delta_zero3_int8"] = round(
+        abs(finals["zero3_int8"] - finals["zero3_fp32"]), 6)
+    out["int8_vs_fp32"] = round(
+        out["int8"]["step_ms"] / max(out["fp32"]["step_ms"], 1e-9), 3)
+    out["quant_overhead_ms_64k"] = round(
+        QC.measure_quant_overhead_ms((1 << 16,), dtype="int8"), 3)
+    return out
+
+
 SECTIONS = {
     "layer_fwd": section_layer_fwd,
     "train_step": section_train_step,
@@ -627,6 +709,7 @@ SECTIONS = {
     "masked_flash": section_masked_flash,
     "train_loop": section_train_loop,
     "tp_overlap": section_tp_overlap,
+    "quant_comm": section_quant_comm,
 }
 
 
@@ -642,7 +725,7 @@ DEADLINE_S = float(os.environ.get("GALVATRON_BENCH_DEADLINE", "200" if SMOKE els
 # (~20-40s each), so it gets headroom; the deadline still caps the total
 SECTION_BUDGETS = {"layer_fwd": 300.0, "train_step": 360.0, "breakdown": 200.0,
                    "masked_flash": 180.0, "train_loop": 200.0,
-                   "tp_overlap": 200.0}
+                   "tp_overlap": 200.0, "quant_comm": 200.0}
 _START = time.time()
 _ACTIVE_CHILD = None  # Popen of the in-flight section, for watchdog cleanup
 
@@ -719,6 +802,8 @@ def main():
             extra["train_loop"] = results["train_loop"]
         if results.get("tp_overlap"):
             extra["tp_overlap"] = results["tp_overlap"]
+        if results.get("quant_comm"):
+            extra["quant_comm"] = results["quant_comm"]
         if errors:
             extra["errors"] = errors
         _kill_active_child()  # don't leave a wedged child squatting the chip
@@ -804,6 +889,12 @@ def main():
         reserve_s=floor)
     results["tp_overlap"] = _run_section(
         "tp_overlap", errors, extra_env={
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "")
+                          + " --xla_force_host_platform_device_count=4").strip(),
+        }, reserve_s=floor)
+    results["quant_comm"] = _run_section(
+        "quant_comm", errors, extra_env={
             "JAX_PLATFORMS": "cpu",
             "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "")
                           + " --xla_force_host_platform_device_count=4").strip(),

@@ -103,6 +103,75 @@ def _model_aware_diagnostics(hp: HybridParallelConfig, model_cfg: Any) -> List[D
     return out
 
 
+def _comm_quant_diagnostics(
+    hp: HybridParallelConfig, model_cfg: Any,
+    anomaly_guard: Optional[bool] = None,
+) -> List[D.Diagnostic]:
+    """GLS013/GLS103 for the comm-precision axis
+    (parallel/quant_collectives.py). The quantized grad-sync path refuses —
+    with the same reason string the trace-time assert raises — layouts it
+    cannot express: non-pure-dp layers, vocab parallelism, zero2 grad
+    accumulators, fp8 without runtime support, and composition with the
+    anomaly guard (whose spike/rollback contract expects the bitwise GSPMD
+    loss — `anomaly_guard` is driver state, so the check only fires when
+    the caller passes it). Runnable-but-inert knobs warn GLS103: quantized
+    comm with no dp group, param_comm_dtype with no ZeRO-3 leaf, and
+    tp_comm_quant with nothing routed through the manual TP rings."""
+    from galvatron_tpu.parallel import quant_collectives as QC
+
+    out: List[D.Diagnostic] = []
+    asks = any(
+        s.grad_comm_dtype != "none" or s.param_comm_dtype != "none"
+        for s in hp.layers
+    )
+    if asks:
+        try:
+            inert = all(hp.dp(i) <= 1 for i in range(hp.num_layers))
+        except Exception:
+            inert = False  # broken grids already reported by GLS002
+        if inert:
+            out.append(D.make(
+                "GLS103", "grad/param comm dtypes are set but every layer "
+                "has dp=1: there is no gradient sync to quantize",
+                key="grad_comm_dtype",
+            ))
+        else:
+            reason = QC.quant_comm_reason(model_cfg, hp,
+                                          anomaly_guard=anomaly_guard)
+            if reason is not None:
+                out.append(D.make(
+                    "GLS013", "quantized collectives: %s" % reason,
+                    key="grad_comm_dtype",
+                ))
+        if any(s.param_comm_dtype != "none" and not s.fsdp for s in hp.layers):
+            out.append(D.make(
+                "GLS103", "param_comm_dtype set on a non-ZeRO-3 layer is "
+                "inert: only fsdp=1 layers all-gather parameters",
+                key="param_comm_dtype",
+            ))
+    if hp.tp_comm_quant != "none":
+        # the gspmd combination is refused at construction (GLS013 in
+        # structural_diagnostics); here the runnable-but-odd rest
+        if hp.tp_comm_quant == "fp8_e4m3" and not QC.fp8_supported() \
+                and hp.tp_comm_mode != "gspmd":
+            out.append(D.make(
+                "GLS013", "tp_comm_quant='fp8_e4m3' needs "
+                "jax.numpy.float8_e4m3fn, which this jax does not provide",
+                key="tp_comm_quant",
+            ))
+        elif hp.tp_comm_mode != "gspmd" and (
+                all(s.tp <= 1 for s in hp.layers) or hp.pp > 1):
+            out.append(D.make(
+                "GLS103", "tp_comm_quant=%r is inert: no layer routes "
+                "through the manual TP rings (%s)" % (
+                    hp.tp_comm_quant,
+                    "pp>1 keeps the GSPMD path" if hp.pp > 1
+                    else "every layer has tp=1"),
+                key="tp_comm_quant",
+            ))
+    return out
+
+
 def _tp_comm_mode_diagnostics(hp: HybridParallelConfig, model_cfg: Any) -> List[D.Diagnostic]:
     """GLS012: the manual shard_map TP path (tp_comm_mode != gspmd) refuses
     configs it cannot express — report the refusal here, before any tracing,
@@ -325,16 +394,21 @@ def lint_hp(
     memory_budget_gb: Optional[float] = None,
     memory_profile: Optional[dict] = None,
     file: Optional[str] = None,
+    anomaly_guard: Optional[bool] = None,
 ) -> D.DiagnosticReport:
     """Lint an already-constructed config (the train-driver / search-engine
     hook): engine-consistency + model-aware checks + cost warnings. The
-    construction itself already enforced schema + structure."""
+    construction itself already enforced schema + structure.
+    ``anomaly_guard`` is driver state (not part of the strategy): the train
+    driver passes it so the quantized-comm x guard refusal (GLS013) fires
+    pre-trace; file-level lints leave it None and skip that check."""
     report = D.DiagnosticReport()
     report.extend(hp.structural_diagnostics())
     report.extend(hp.pipeline_engine_diagnostics())
     if model_cfg is not None:
         report.extend(_model_aware_diagnostics(hp, model_cfg))
     report.extend(_tp_comm_mode_diagnostics(hp, model_cfg))
+    report.extend(_comm_quant_diagnostics(hp, model_cfg, anomaly_guard))
     report.extend(_warning_diagnostics(hp, model_cfg, memory_budget_gb, memory_profile))
     if file:
         report.diagnostics = [

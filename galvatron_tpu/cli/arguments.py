@@ -77,6 +77,27 @@ def _add_parallel_args(p: argparse.ArgumentParser):
                         "matmuls so communication hides behind compute "
                         "(parallel/tp_shard_map.py; unsupported configs are "
                         "refused with GLS012, never silently approximated)")
+    g.add_argument("--grad_comm_dtype", type=str, default="none",
+                   choices=("none", "bf16", "int8", "fp8_e4m3"),
+                   help="wire precision of the DP/ZeRO gradient sync "
+                        "(GLOBAL mode: every layer; a searched JSON carries "
+                        "per-layer values). int8/fp8_e4m3 run the explicit "
+                        "blockwise-quantized shard_map ring "
+                        "(parallel/quant_collectives.py, ZeRO++-style); "
+                        "unsupported layouts refuse with GLS013")
+    g.add_argument("--param_comm_dtype", type=str, default="none",
+                   choices=("none", "bf16", "int8", "fp8_e4m3"),
+                   help="wire precision of the ZeRO-3 parameter all-gather "
+                        "(inert without zero3 layers; the linter warns)")
+    g.add_argument("--comm_quant_block", type=int, default=64,
+                   help="elements per absmax scale block for every "
+                        "quantized collective payload")
+    g.add_argument("--tp_comm_quant", type=str, default="none",
+                   choices=("none", "bf16", "int8", "fp8_e4m3"),
+                   help="wire precision of the manual TP ring payloads "
+                        "(requires --tp_comm_mode shard_map|overlap; "
+                        "refused under gspmd with GLS013). Runtime knob "
+                        "like --tp_comm_mode: not serialized")
     g.add_argument("--galvatron_config_path", type=str, default=None,
                    help="searched per-layer strategy JSON; overrides the GLOBAL flags above")
     g.add_argument("--world_size", type=int, default=None, help="devices to use (default: all)")
@@ -306,6 +327,23 @@ def _add_search_args(p: argparse.ArgumentParser):
     g.add_argument("--parallel_search", type=int, default=0)
     g.add_argument("--log_dir", type=str, default="logs")
     g.add_argument("--output_config_path", type=str, default=None)
+    # comm-precision search axis (ROADMAP item 2: EQuARX / ZeRO++)
+    g.add_argument("--comm_quant", type=str, default="off",
+                   choices=("off", "bf16", "int8", "fp8_e4m3"),
+                   help="let the search choose per-layer grad/param comm "
+                        "precision: each pure-dp strategy gains a variant "
+                        "whose gradient sync (and zero3 gather) uses this "
+                        "wire dtype; the DP picks per layer under the "
+                        "accuracy budget. off (default) keeps the "
+                        "full-precision-only space")
+    g.add_argument("--comm_quant_block", type=int, default=64,
+                   help="blockwise-quantization block size priced by the "
+                        "cost models and emitted into the strategy JSON")
+    g.add_argument("--comm_quant_budget", type=float, default=1.0,
+                   help="accuracy budget: max fraction of layers allowed a "
+                        "quantized gradient sync (1.0 = all; 0.0 "
+                        "effectively disables). Layers with the smallest "
+                        "modeled time saving are de-quantized first")
 
 
 def build_parser(mode: str, extra_args_provider: Optional[Callable] = None) -> argparse.ArgumentParser:
@@ -373,8 +411,12 @@ def hp_config_from_args(args, num_layers: int, world_size: int):
         scan_layers=getattr(args, "scan_layers", True),
         remat_policy=getattr(args, "remat_policy", "full"),
         tp_comm_mode=getattr(args, "tp_comm_mode", "gspmd"),
+        tp_comm_quant=getattr(args, "tp_comm_quant", "none"),
     )
     if getattr(args, "galvatron_config_path", None):
+        # grad/param comm dtypes + comm_quant_block are SERIALIZED strategy
+        # fields: the searched JSON's per-layer values win over the GLOBAL
+        # flags (like every other per-layer field)
         return HybridParallelConfig.from_json(
             args.galvatron_config_path, world_size=world_size,
             global_bsz=args.global_train_batch_size, mixed_precision=args.mixed_precision,
@@ -389,6 +431,9 @@ def hp_config_from_args(args, num_layers: int, world_size: int):
         sp=1 if args.use_ulysses else 0,
         sdp=args.sdp,
         checkpoint=args.checkpoint,
+        grad_comm_dtype=getattr(args, "grad_comm_dtype", "none"),
+        param_comm_dtype=getattr(args, "param_comm_dtype", "none"),
+        comm_quant_block=getattr(args, "comm_quant_block", 64),
         global_bsz=args.global_train_batch_size,
         chunks=args.chunks,
         pipeline_type=args.pipeline_type,

@@ -40,6 +40,9 @@ def search_args_from(args) -> SearchArgs:
         default_dp_type=getattr(args, "default_dp_type", "ddp"),
         parallel_search=bool(args.parallel_search),
         log_dir=args.log_dir,
+        comm_quant=getattr(args, "comm_quant", "off"),
+        comm_quant_block=getattr(args, "comm_quant_block", 64),
+        comm_quant_budget=getattr(args, "comm_quant_budget", 1.0),
     )
 
 

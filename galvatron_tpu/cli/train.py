@@ -219,6 +219,9 @@ def _train(args) -> dict:
 
     _report = _slint.lint_hp(
         hp, model_cfg=cfg, file=getattr(args, "galvatron_config_path", None),
+        # driver state the strategy alone cannot see: quantized grad sync
+        # composed with the anomaly guard refuses (GLS013) before tracing
+        anomaly_guard=bool(getattr(args, "anomaly_guard", 0)),
     )
     if jax.process_index() == 0:
         for _d in _report.warnings:
@@ -291,6 +294,41 @@ def _train(args) -> dict:
             comm_hidden_rows = []
         for row in comm_hidden_rows:
             telemetry.emit("tp_overlap", mode=hp.tp_comm_mode, **row)
+
+    # Quantized-collectives accounting: when the strategy carries a comm-
+    # precision axis (grad/param comm dtypes or a quantized TP ring), record
+    # the wire dtypes, the measured quantize+dequantize toll, and the
+    # bytes-on-wire estimate — one `quant_comm` telemetry event `cli report`
+    # joins into the predicted-vs-measured table. Observation-only (same
+    # gating as the overlap measurement): never on the training hot path.
+    from galvatron_tpu.parallel import quant_collectives as QC
+
+    if ((QC.wants_quant_comm(hp) or hp.tp_comm_quant != "none")
+            and (args.profile or telemetry.active_sink() is not None)):
+        try:
+            overhead_ms = QC.measure_quant_overhead_ms(
+                (1 << 16,), dtype="int8", block=hp.comm_quant_block)
+        except Exception:
+            overhead_ms = None
+        wire = None
+        try:
+            from galvatron_tpu.analysis.strategy_lint import _analytic_parameter_mb
+
+            pmb = _analytic_parameter_mb(cfg)
+            wire = QC.bytes_on_wire_mb(hp, pmb) if pmb else None
+        except Exception:
+            wire = None
+        telemetry.emit(
+            "quant_comm",
+            grad_comm_dtype=",".join(s.grad_comm_dtype for s in hp.layers),
+            param_comm_dtype=",".join(s.param_comm_dtype for s in hp.layers),
+            comm_quant_block=hp.comm_quant_block,
+            tp_comm_quant=hp.tp_comm_quant
+            if hp.tp_comm_quant != "none" else None,
+            quant_overhead_ms=overhead_ms,
+            wire_mb_fp32=(wire or {}).get("fp32"),
+            wire_mb_configured=(wire or {}).get("configured"),
+        )
 
     params = model.init_params(jax.random.PRNGKey(args.seed))
     opt_state = model.init_opt_state(tx, params)

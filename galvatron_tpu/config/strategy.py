@@ -58,6 +58,15 @@ REMAT_POLICIES = ("none", "full", "dots_saveable", "nothing_saveable")
 # overlapped with compute, the ring_attention idiom on the dense kernels).
 # A runtime knob like remat_policy: NOT serialized into the strategy JSON.
 TP_COMM_MODES = ("gspmd", "shard_map", "overlap")
+# Wire precision of a collective's payload (parallel/quant_collectives.py):
+# "none" keeps the exact full-precision collective, "bf16" is a passthrough
+# cast, int8/fp8_e4m3 are blockwise-quantized (per-block absmax scales,
+# block size = comm_quant_block). grad_comm_dtype (DP/ZeRO gradient sync)
+# and param_comm_dtype (ZeRO-3 weight all-gather) are SERIALIZED per-layer
+# strategy fields — the search engine chooses them per layer (ROADMAP item
+# 2) — unlike tp_comm_quant, which quantizes the PR-8 TP ring payloads and
+# stays a runtime knob like tp_comm_mode.
+COMM_DTYPES = ("none", "bf16", "int8", "fp8_e4m3")
 
 # The reference-compatible on-disk schema (from_json/to_json_dict). Split by
 # shape so the schema linter can check lengths/types uniformly.
@@ -65,12 +74,27 @@ PER_LAYER_KEYS = (
     "tp_sizes_enc", "tp_consecutive_flags", "cp_sizes_enc", "dp_types_enc",
     "use_sp", "checkpoint",
 )
+# per-layer comma-separated STRING enums (COMM_DTYPES), not int lists
+PER_LAYER_STR_KEYS = ("grad_comm_dtype", "param_comm_dtype")
 SCALAR_KEYS = (
     "pp_deg", "global_bsz", "chunks", "pp_division", "pipeline_type",
     "default_dp_type", "vtp", "vsp", "vcp", "embed_sdp", "cp_mode",
+    "comm_quant_block",
 )
-KNOWN_STRATEGY_KEYS = frozenset(PER_LAYER_KEYS + SCALAR_KEYS)
+KNOWN_STRATEGY_KEYS = frozenset(PER_LAYER_KEYS + PER_LAYER_STR_KEYS + SCALAR_KEYS)
 REQUIRED_STRATEGY_KEYS = ("pp_deg", "tp_sizes_enc", "dp_types_enc")
+
+
+def str2strlist(v) -> List[str]:
+    """'none,int8,int8' -> ['none', 'int8', 'int8'] (the string-enum
+    analogue of utils.strategy_utils.str2array)."""
+    if isinstance(v, (list, tuple)):
+        return [str(x).strip() for x in v]
+    return [s.strip() for s in str(v).split(",") if s.strip()]
+
+
+def strlist2str(vals: Sequence[str]) -> str:
+    return ",".join(str(v) for v in vals)
 
 
 def schema_diagnostics(cfg: dict) -> list:
@@ -101,14 +125,31 @@ def schema_diagnostics(cfg: dict) -> list:
                     "GLS005", "key %r is not a comma-separated int list: %r"
                     % (k, cfg[k]), key=k,
                 ))
+    str_arrays = {}
+    for k in PER_LAYER_STR_KEYS:
+        if k in cfg:
+            str_arrays[k] = str2strlist(cfg[k])
+            for i, v in enumerate(str_arrays[k]):
+                if v not in COMM_DTYPES:
+                    out.append(D.make(
+                        "GLS005", "%s[%d]=%r must be one of %s"
+                        % (k, i, v, COMM_DTYPES), key=k, layer=i,
+                        hint=D.did_you_mean(v, COMM_DTYPES),
+                    ))
     if "tp_sizes_enc" in arrays:
         n = len(arrays["tp_sizes_enc"])
-        for k, arr in arrays.items():
+        for k, arr in list(arrays.items()) + list(str_arrays.items()):
             if len(arr) != n:
                 out.append(D.make(
                     "GLS006", "%r has %d entries but 'tp_sizes_enc' has %d"
                     % (k, len(arr), n), key=k,
                 ))
+    cqb = cfg.get("comm_quant_block")
+    if cqb is not None and (not isinstance(cqb, int) or cqb < 1):
+        out.append(D.make(
+            "GLS005", "comm_quant_block must be a positive int, got %r" % (cqb,),
+            key="comm_quant_block",
+        ))
     for k, lo in (("tp_sizes_enc", 1), ("cp_sizes_enc", 1)):
         for i, v in enumerate(arrays.get(k, [])):
             if v < lo:
@@ -147,12 +188,20 @@ class LayerStrategy:
     fsdp: int = 0
     checkpoint: int = 0
     tp_consec: int = 1
+    # wire precision of this layer's collectives (COMM_DTYPES; serialized —
+    # the search engine's comm-precision axis chooses these per layer):
+    grad_comm_dtype: str = "none"   # DP/ZeRO gradient sync payload
+    param_comm_dtype: str = "none"  # ZeRO-3 weight all-gather payload
 
     def __post_init__(self):
         if self.tp < 1 or self.cp < 1:
             raise ValueError("tp/cp degrees must be >= 1, got tp=%d cp=%d" % (self.tp, self.cp))
         if self.sp not in (0, 1) or self.fsdp not in (0, 1):
             raise ValueError("sp/fsdp must be 0/1")
+        for k in ("grad_comm_dtype", "param_comm_dtype"):
+            if getattr(self, k) not in COMM_DTYPES:
+                raise ValueError("%s must be one of %s, got %r"
+                                 % (k, COMM_DTYPES, getattr(self, k)))
 
     @property
     def seq_shard_degree(self) -> int:
@@ -251,6 +300,14 @@ class HybridParallelConfig:
     # (depth-constant trace/compile cost); False = unroll every layer
     remat_policy: str = "full"  # REMAT_POLICIES: policy for checkpoint=1 layers
     tp_comm_mode: str = "gspmd"  # TP_COMM_MODES: TP-collective execution path
+    tp_comm_quant: str = "none"  # COMM_DTYPES: wire precision of the manual
+    # TP ring payloads (parallel/tp_shard_map.py); requires a manual
+    # tp_comm_mode — the compiler owns the gspmd collectives (GLS013).
+    # Runtime knob like tp_comm_mode: NOT serialized.
+    # Block size of the blockwise quantization (elements per absmax scale)
+    # for every quantized collective. Serialized (the cost models price the
+    # scale overhead through it).
+    comm_quant_block: int = 64
 
     def __post_init__(self):
         if self.pp_division is None:
@@ -293,6 +350,27 @@ class HybridParallelConfig:
                 "GLS005", "tp_comm_mode must be one of %s, got %r"
                 % (TP_COMM_MODES, self.tp_comm_mode), key="tp_comm_mode",
                 hint=D.did_you_mean(str(self.tp_comm_mode), TP_COMM_MODES),
+            ))
+        if self.tp_comm_quant not in COMM_DTYPES:
+            out.append(D.make(
+                "GLS005", "tp_comm_quant must be one of %s, got %r"
+                % (COMM_DTYPES, self.tp_comm_quant), key="tp_comm_quant",
+                hint=D.did_you_mean(str(self.tp_comm_quant), COMM_DTYPES),
+            ))
+        elif self.tp_comm_quant != "none" and self.tp_comm_mode == "gspmd":
+            # the compiler owns the gspmd collectives: there is no ring
+            # payload to quantize, and silently ignoring the knob would
+            # break the never-silently-differ contract
+            out.append(D.make(
+                "GLS013", "tp_comm_quant=%r requires a manual tp_comm_mode "
+                "(shard_map or overlap); gspmd collectives are compiler-"
+                "derived and cannot carry a quantized ring payload"
+                % self.tp_comm_quant, key="tp_comm_quant",
+            ))
+        if not isinstance(self.comm_quant_block, int) or self.comm_quant_block < 1:
+            out.append(D.make(
+                "GLS005", "comm_quant_block must be a positive int, got %r"
+                % (self.comm_quant_block,), key="comm_quant_block",
             ))
         if self.pp < 1 or self.world_size % self.pp != 0:
             out.append(D.make(
@@ -485,11 +563,15 @@ class HybridParallelConfig:
         sp: int = 0,
         sdp: int = 0,
         checkpoint: int = 0,
+        grad_comm_dtype: str = "none",
+        param_comm_dtype: str = "none",
         **kw,
     ) -> "HybridParallelConfig":
         """GLOBAL-mode config: one strategy for every layer (reference
         hybrid_parallel_config.py:27-42)."""
-        layer = LayerStrategy(tp=tp, cp=cp, sp=sp, fsdp=sdp, checkpoint=checkpoint)
+        layer = LayerStrategy(tp=tp, cp=cp, sp=sp, fsdp=sdp, checkpoint=checkpoint,
+                              grad_comm_dtype=grad_comm_dtype,
+                              param_comm_dtype=param_comm_dtype)
         return cls(world_size=world_size, pp=pp, layers=[layer] * num_layers, **kw)
 
     @classmethod
@@ -513,10 +595,15 @@ class HybridParallelConfig:
         dp_types = str2array(cfg["dp_types_enc"])
         use_sp = str2array(cfg.get("use_sp", array2str([0] * n)))
         ckpt = str2array(cfg.get("checkpoint", array2str([0] * n)))
+        gcd = str2strlist(cfg["grad_comm_dtype"]) if "grad_comm_dtype" in cfg \
+            else ["none"] * n
+        pcd = str2strlist(cfg["param_comm_dtype"]) if "param_comm_dtype" in cfg \
+            else ["none"] * n
         layers = [
             LayerStrategy(
                 tp=tp_sizes[i], cp=cp_sizes[i], sp=use_sp[i], fsdp=dp_types[i],
                 checkpoint=ckpt[i], tp_consec=consec[i],
+                grad_comm_dtype=gcd[i], param_comm_dtype=pcd[i],
             )
             for i in range(n)
         ]
@@ -534,6 +621,7 @@ class HybridParallelConfig:
             vocab_cp=cfg.get("vcp", 1),
             embed_sdp=cfg.get("embed_sdp", 0),
             cp_mode=cfg.get("cp_mode", "zigzag"),
+            comm_quant_block=cfg.get("comm_quant_block", 64),
         )
         kw.update(overrides)
         return cls(**kw)
@@ -560,6 +648,9 @@ class HybridParallelConfig:
             "vcp": self.vocab_cp,
             "embed_sdp": self.embed_sdp,
             "cp_mode": self.cp_mode,
+            "grad_comm_dtype": strlist2str([s.grad_comm_dtype for s in self.layers]),
+            "param_comm_dtype": strlist2str([s.param_comm_dtype for s in self.layers]),
+            "comm_quant_block": self.comm_quant_block,
         }
 
     def save(self, path: str):
@@ -579,13 +670,17 @@ class HybridParallelConfig:
             self.pipeline_type, self.default_dp_type)]
         for i, s in enumerate(self.layers):
             lines.append(
-                "  layer %2d: stage %d tp=%d%s cp=%d dp=%d(%s)%s%s"
+                "  layer %2d: stage %d tp=%d%s cp=%d dp=%d(%s)%s%s%s%s"
                 % (
                     i, self.stage_of_layer[i], s.tp,
                     "(ulysses-sp)" if s.sp else "",
                     s.cp, self.dp(i), self.dp_type(i),
                     " ckpt" if s.checkpoint else "",
                     "" if s.tp_consec else " nonconsec",
+                    " gcomm=%s" % s.grad_comm_dtype
+                    if s.grad_comm_dtype != "none" else "",
+                    " pcomm=%s" % s.param_comm_dtype
+                    if s.param_comm_dtype != "none" else "",
                 )
             )
         lines.append(

@@ -46,13 +46,18 @@ def strategy_as_list(s: LayerStrategy, hp: HybridParallelConfig, layer_idx: int)
         info["cpt"] = 1
     if not s.tp_consec:
         info["tp"] = 0
+    if s.grad_comm_dtype != "none":
+        info["gcd"] = s.grad_comm_dtype
+    if s.param_comm_dtype != "none":
+        info["pcd"] = s.param_comm_dtype
     return [hp.pp, s.tp, hp.dp(layer_idx), info]
 
 
 def describe_strategy(s: LayerStrategy, hp: HybridParallelConfig, layer_idx: int) -> str:
-    return "tp%d%s cp%d dp%d%s%s" % (
+    return "tp%d%s cp%d dp%d%s%s%s" % (
         s.tp, "(sp)" if s.sp else "", s.cp, hp.dp(layer_idx),
         "(z3)" if s.fsdp else "", " ckpt" if s.checkpoint else "",
+        " g%s" % s.grad_comm_dtype if s.grad_comm_dtype != "none" else "",
     )
 
 
@@ -131,6 +136,7 @@ def predict_layer_runs(
         chunks=hp.chunks,
         pipeline_type=hp.pipeline_type,
         disable_vtp=True,  # embed/head is the HEAD_RUN flops row, not priced here
+        comm_quant_block=hp.comm_quant_block,
     )
     pma = ProfileModelArgs(
         forward_computation_time=fwd_time,
@@ -182,6 +188,14 @@ def predict_layer_runs(
             if tp_comm_mode == "overlap":
                 entry["predicted_comm_hidden_ms"] = round(
                     per_layer_hidden_ms * run.length, 4)
+        # comm-precision axis: what the cost model charges for the
+        # quantize/dequantize passes rides its own column so the report can
+        # lay it beside the measured quant_comm event
+        if run.strategy.grad_comm_dtype != "none" \
+                or run.strategy.param_comm_dtype != "none":
+            entry["grad_comm_dtype"] = run.strategy.grad_comm_dtype
+            entry["predicted_quant_overhead_ms"] = round(
+                tcm.quant_overhead_ms * scale * run.length, 4)
         if run_flops is not None:
             entry["flops"] = run_flops[idx]
             entry["flops_share"] = round(run_flops[idx] / total_flops, 6)
@@ -214,6 +228,7 @@ def divergence_rows(
             "run", "start", "stop", "strategy", "predicted_ms",
             "predicted_memory_mb", "flops_share", "tp_comm_mode",
             "predicted_comm_ms", "predicted_comm_hidden_ms",
+            "grad_comm_dtype", "predicted_quant_overhead_ms",
         )}
         share = p.get("flops_share")
         if measured_step_ms is not None and share is not None:
@@ -236,10 +251,13 @@ def render_divergence_table(rows: List[Dict[str, Any]]) -> str:
     # the comm columns only render when some run priced a TP-collective
     # path (tp>1); dp-only tables keep the original width
     has_comm = any(r.get("predicted_comm_ms") is not None for r in rows)
+    has_quant = any(r.get("grad_comm_dtype") is not None for r in rows)
     header = ("run", "layers", "strategy", "pred_ms", "meas_ms", "ratio",
               "pred_mb", "share")
     if has_comm:
         header += ("comm_ms", "hid_ms")
+    if has_quant:
+        header += ("gcomm", "q_ms")
     body = []
     for r in rows:
         run = r.get("run")
@@ -258,6 +276,9 @@ def render_divergence_table(rows: List[Dict[str, Any]]) -> str:
         if has_comm:
             cells += (_fmt(r.get("predicted_comm_ms")),
                       _fmt(r.get("predicted_comm_hidden_ms")))
+        if has_quant:
+            cells += (_fmt(r.get("grad_comm_dtype")),
+                      _fmt(r.get("predicted_quant_overhead_ms")))
         body.append(cells)
     widths = [max(len(header[i]), *(len(b[i]) for b in body)) for i in range(len(header))]
     lines = ["  ".join(h.ljust(w) for h, w in zip(header, widths))]

@@ -119,6 +119,13 @@ def analyze(
         {k: v for k, v in e.items() if k not in ("v", "t", "seq", "type")}
         for e in by_type.get("tp_overlap", [])
     ]
+    # comm-precision axis (quantized collectives): the run-level wire
+    # dtypes + measured quant toll sit beside the divergence table, whose
+    # per-run gcomm/q_ms columns carry the predictions
+    quant_events = [
+        {k: v for k, v in e.items() if k not in ("v", "t", "seq", "type")}
+        for e in by_type.get("quant_comm", [])
+    ]
     if overlap_events and divergence:
         by_run = {e.get("run"): e for e in overlap_events}
         for row in divergence:
@@ -171,6 +178,7 @@ def analyze(
         },
         "divergence": divergence,
         "tp_overlap": overlap_events,
+        "quant_comm": quant_events,
         "timeline": timeline,
     }
     run_end = by_type.get("run_end")
@@ -231,6 +239,21 @@ def render(analysis: Dict[str, Any]) -> str:
     lines.append("")
     lines.append("predicted vs measured per layer run:")
     lines.append(A.render_divergence_table(analysis["divergence"]))
+    if analysis.get("quant_comm"):
+        lines.append("")
+        lines.append("quantized collectives:")
+        for e in analysis["quant_comm"]:
+            lines.append(
+                "  grad wire %s | param wire %s | block %s | tp ring %s | "
+                "quant toll %s ms | wire MB %s (fp32 %s)"
+                % (_fmt(e.get("grad_comm_dtype")),
+                   _fmt(e.get("param_comm_dtype")),
+                   _fmt(e.get("comm_quant_block")),
+                   _fmt(e.get("tp_comm_quant")),
+                   _fmt(e.get("quant_overhead_ms")),
+                   _fmt(e.get("wire_mb_configured")),
+                   _fmt(e.get("wire_mb_fp32")))
+            )
     if analysis.get("tp_overlap"):
         lines.append("")
         lines.append("TP overlap (decomposed collectives, measured):")

@@ -111,7 +111,8 @@ EVENT_SCHEMAS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
         ("run", "start", "stop"),
         ("strategy", "predicted_ms", "predicted_memory_mb", "flops",
          "flops_share", "tp_comm_mode", "predicted_comm_ms",
-         "predicted_comm_hidden_ms"),
+         "predicted_comm_hidden_ms", "grad_comm_dtype",
+         "predicted_quant_overhead_ms"),
     ),
     # measured compute/collective overlap of the decomposed TP path
     # (parallel/tp_shard_map.measure_comm_hidden): per TP LayerRun, the
@@ -122,6 +123,15 @@ EVENT_SCHEMAS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
         ("run",),
         ("start", "stop", "mode", "overlap_ms", "serial_ms",
          "comm_hidden_ms"),
+    ),
+    # comm-precision axis (parallel/quant_collectives.py): the run's wire
+    # dtypes (comma list per layer), the measured quantize+dequantize toll,
+    # and the bytes-on-wire estimate vs an fp32 sync — `cli report` joins
+    # these into the predicted-vs-measured view
+    "quant_comm": (
+        ("grad_comm_dtype",),
+        ("param_comm_dtype", "comm_quant_block", "tp_comm_quant",
+         "quant_overhead_ms", "wire_mb_fp32", "wire_mb_configured"),
     ),
     # jax.profiler start/stop_trace bracketing (--xla_trace)
     "trace": (("action",), ("dir", "first_step", "last_step", "error")),

@@ -1,0 +1,552 @@
+"""Quantized collectives: blockwise int8/fp8 payloads for the DP/ZeRO
+gradient sync, the ZeRO-3 parameter all-gather, and the decomposed TP rings.
+
+On bandwidth-bound dp/zero3 configs the step time is dominated by two
+collectives: the gradient sync (all-reduce under ddp, reduce-scatter under
+ZeRO) and the ZeRO-3 weight all-gather. EQuARX (arXiv:2506.17615) shows a
+quantized AllReduce inside XLA for exactly this stack; ZeRO++
+(arXiv:2306.10209) shows blockwise-int8 gradient sync and quantized ZeRO-3
+weight gather at production scale. This module is the jax-userland
+equivalent, built on the same machinery PR 8 established for the TP rings
+(`lax.ppermute` rings under `jax.shard_map`):
+
+- **blockwise symmetric quantization** (`quantize_blockwise` /
+  `dequantize_blockwise`): per-block absmax scales (block size a knob,
+  ``comm_quant_block``), int8 or fp8-e4m3 wire payloads, deterministic
+  round-half-even. ``bf16``/``fp32`` are passthrough payloads (a precision
+  cast on the wire, no scales).
+- **quantized rings**: `ring_all_reduce` = reduce-scatter with quantized
+  wire hops and fp32 dequant-accumulate, then a quantized all-gather of the
+  reduced chunk (the ZeRO++ gradient-sync schedule); `ring_all_gather` /
+  `ring_reduce_scatter` along an arbitrary dim serve the ZeRO-3 parameter
+  gather and its cotangent reduce-scatter (`make_qgather`, one custom_vjp:
+  quantized weight gather forward, quantized grad reduce-scatter backward).
+- **the explicit grad-sync train path** (`make_quant_loss_and_grads`): for
+  pure data-parallel layouts (pp=1, tp=1, cp=1, no ulysses — the ZeRO++
+  domain) the whole loss+grad computation runs under ONE `jax.shard_map`
+  over the dp axes. Inside the manual region each device computes grads on
+  its local batch shard through the constraint-free local loss path
+  (models/base loss_fns with hp=None), so the cross-device gradient
+  reduction becomes OUR ring instead of a GSPMD-inserted collective — the
+  seam GSPMD never exposes. Per-layer ``grad_comm_dtype`` /
+  ``param_comm_dtype`` (serialized strategy fields) choose each leaf's wire
+  precision; ``none`` leaves ride exact `lax.psum` / native gathers.
+
+Numerics contract (mirroring tp_shard_map's): layouts the quantized path
+cannot express are REFUSED with a GLS013 diagnostic — at lint time
+(strategy_lint) and again at trace time — never silently approximated.
+``bf16`` payloads of a bf16-computed gradient are bitwise the cast chain;
+quantized payloads carry a bounded relative error per block (<= 1/(2*qmax)
+of the block absmax per wire hop), pinned by
+tests/parallel/test_quant_collectives.py.
+
+jax 0.4.37 notes (inherited from PR 8, pinned in memory + tests): the
+shard_map here is manual over the dp axes with the size-1 'pp' axis auto
+(compiles fine; true partial-manual does not); custom_vjp bodies compute
+`lax.axis_index` inside the traced function, never close over it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+# COMM_DTYPES lives with the schema (config/strategy.py) — the serialized
+# per-layer fields validate against it; re-exported here for callers of the
+# kernel API. "none" keeps the exact full-precision collective (GSPMD /
+# lax.psum); "bf16" is a passthrough cast (half the bytes, no scales);
+# int8 / fp8_e4m3 are blockwise-quantized.
+from galvatron_tpu.config.strategy import COMM_DTYPES, HybridParallelConfig
+
+QUANTIZED_DTYPES = ("int8", "fp8_e4m3")
+
+_QMAX = {"int8": 127.0, "fp8_e4m3": 448.0}
+# wire bytes per element, scales included at the given block size
+def wire_bytes_per_element(dtype: str, block: int, full_bytes: float = 4.0) -> float:
+    """Bytes on the wire per gradient element for one collective pass:
+    payload + fp32 per-block scale amortised over the block. The cost
+    models' comm-precision axis prices volume through this same function."""
+    if dtype == "none":
+        return full_bytes
+    if dtype == "bf16":
+        return 2.0
+    return 1.0 + 4.0 / max(int(block), 1)
+
+
+def fp8_supported() -> bool:
+    """Whether the installed jax/ml_dtypes ships float8_e4m3fn."""
+    return hasattr(jnp, "float8_e4m3fn")
+
+
+def _payload_jnp_dtype(dtype: str):
+    if dtype == "int8":
+        return jnp.int8
+    if dtype == "fp8_e4m3":
+        if not fp8_supported():
+            raise TypeError("installed jax has no float8_e4m3fn")
+        return jnp.float8_e4m3fn
+    raise ValueError("not a quantized wire dtype: %r" % dtype)
+
+
+# ============================================================ quant kernels
+def quantize_blockwise(x: jax.Array, dtype: str, block: int):
+    """Flatten ``x`` and quantize in blocks of ``block`` elements.
+
+    Returns ``(payload, scales)``: payload ``(nblk, block)`` in the wire
+    dtype, scales ``(nblk,)`` fp32 (absmax / qmax; all-zero blocks get
+    scale 1 so the payload is exactly zero). The tail is zero-padded to a
+    block multiple — callers slice back with the original shape.
+    Deterministic: jnp.round (half-to-even), no RNG."""
+    qmax = _QMAX[dtype]
+    flat = x.reshape(-1).astype(jnp.float32)
+    pad = (-flat.shape[0]) % block
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+    blocks = flat.reshape(-1, block)
+    amax = jnp.max(jnp.abs(blocks), axis=1)
+    scales = jnp.where(amax > 0.0, amax / qmax, 1.0).astype(jnp.float32)
+    scaled = blocks / scales[:, None]
+    if dtype == "int8":
+        payload = jnp.clip(jnp.round(scaled), -qmax, qmax).astype(jnp.int8)
+    else:
+        payload = jnp.clip(scaled, -qmax, qmax).astype(_payload_jnp_dtype(dtype))
+    return payload, scales
+
+
+def dequantize_blockwise(payload: jax.Array, scales: jax.Array, shape,
+                         out_dtype=jnp.float32) -> jax.Array:
+    """Inverse of quantize_blockwise: drop the pad, restore ``shape``."""
+    flat = payload.astype(jnp.float32) * scales[:, None]
+    n = int(np.prod(shape)) if shape else 1
+    return flat.reshape(-1)[:n].reshape(shape).astype(out_dtype)
+
+
+# --------------------------------------------------------- wire transports
+def _ring_perm(n: int) -> List[Tuple[int, int]]:
+    return [(j, (j + 1) % n) for j in range(n)]
+
+
+def _flat_axis_index(axis_names: Tuple[str, ...], sizes: Tuple[int, ...]):
+    idx = jnp.int32(0)
+    for name, size in zip(axis_names, sizes):
+        idx = idx * size + jax.lax.axis_index(name)
+    return idx
+
+
+def _wire_hop(x: jax.Array, axes, perm, dtype: str, block: int) -> jax.Array:
+    """One ppermute hop of ``x`` at the requested wire precision: quantize
+    for the wire, permute payload+scales, dequantize on arrival (fp32).
+    This is the only place values leave the device at reduced precision —
+    accumulation stays fp32 (the ZeRO++ discipline)."""
+    if dtype == "none":
+        return jax.lax.ppermute(x, axes, perm)
+    if dtype == "bf16":
+        sent = jax.lax.ppermute(x.astype(jnp.bfloat16), axes, perm)
+        return sent.astype(x.dtype)
+    payload, scales = quantize_blockwise(x, dtype, block)
+    payload = jax.lax.ppermute(payload, axes, perm)
+    scales = jax.lax.ppermute(scales, axes, perm)
+    return dequantize_blockwise(payload, scales, x.shape, x.dtype)
+
+
+# ============================================================== collectives
+# All of these run INSIDE a shard_map body manual over ``axes`` (tuples of
+# mesh axis names, major->minor, with ``sizes`` their mesh sizes).
+
+def ring_all_gather(x: jax.Array, axes: Tuple[str, ...], sizes: Tuple[int, ...],
+                    *, axis: int = 0, dtype: str = "none",
+                    block: int = 64) -> jax.Array:
+    """All-gather the local shard along ``axis`` with the shard quantized
+    ONCE and the (payload, scales) pair riding the ring; each arriving
+    block dequantizes into its source's slot (same index arithmetic as the
+    PR-8 column ring). ``dtype='none'`` uses the native tiled all_gather."""
+    n = int(np.prod(sizes))
+    if n == 1:
+        return x
+    if dtype == "none":
+        return jax.lax.all_gather(x, axes, axis=axis, tiled=True)
+    xm = jnp.moveaxis(x, axis, 0)
+    s = xm.shape[0]
+    idx = _flat_axis_index(axes, sizes)
+    perm = _ring_perm(n)
+    out = jnp.zeros((n * s,) + xm.shape[1:], jnp.float32)
+    if dtype == "bf16":
+        cur: Any = xm.astype(jnp.bfloat16)
+        decode = lambda c: c.astype(jnp.float32)  # noqa: E731
+        hop = lambda c: jax.lax.ppermute(c, axes, perm)  # noqa: E731
+    else:
+        cur = quantize_blockwise(xm, dtype, block)
+        decode = lambda c: dequantize_blockwise(c[0], c[1], xm.shape)  # noqa: E731
+        hop = lambda c: (jax.lax.ppermute(c[0], axes, perm),  # noqa: E731
+                         jax.lax.ppermute(c[1], axes, perm))
+    for step in range(n):
+        src = jnp.mod(idx - step, n)
+        out = jax.lax.dynamic_update_slice_in_dim(out, decode(cur), src * s, 0)
+        if step < n - 1:
+            cur = hop(cur)
+    return jnp.moveaxis(out, 0, axis).astype(x.dtype)
+
+
+def ring_reduce_scatter(x: jax.Array, axes: Tuple[str, ...],
+                        sizes: Tuple[int, ...], *, axis: int = 0,
+                        dtype: str = "none", block: int = 64) -> jax.Array:
+    """Reduce-scatter ``x`` (each device holds a full partial sum) along
+    ``axis``: a rotating accumulator picks up each device's block for its
+    destination, quantized on every wire hop, accumulated in fp32
+    (ZeRO++-style int8 gradient sync). Returns this device's reduced
+    1/n-slice. ``dtype='none'`` uses the native psum_scatter."""
+    n = int(np.prod(sizes))
+    if n == 1:
+        return x
+    if dtype == "none":
+        return jax.lax.psum_scatter(x, axes, scatter_dimension=axis, tiled=True)
+    xm = jnp.moveaxis(x, axis, 0).astype(jnp.float32)
+    s = xm.shape[0] // n
+    idx = _flat_axis_index(axes, sizes)
+    perm = _ring_perm(n)
+    acc = None
+    for step in range(n):
+        dest = jnp.mod(idx - 1 - step, n)
+        part = jax.lax.dynamic_slice_in_dim(xm, dest * s, s, 0)
+        if acc is None:
+            acc = part
+        else:
+            acc = _wire_hop(acc, axes, perm, dtype, block) + part
+    return jnp.moveaxis(acc, 0, axis).astype(x.dtype)
+
+
+def ring_all_reduce(x: jax.Array, axes: Tuple[str, ...], sizes: Tuple[int, ...],
+                    *, dtype: str = "none", block: int = 64) -> jax.Array:
+    """Sum-all-reduce with quantized wire traffic: flat reduce-scatter
+    (quantized hops, fp32 accumulate) then a quantized all-gather of the
+    reduced chunk — 2x(n-1)/n quantized volume, the ZeRO++ schedule.
+    ``dtype='none'`` is an exact lax.psum."""
+    n = int(np.prod(sizes))
+    if n == 1:
+        return x
+    if dtype == "none":
+        return jax.lax.psum(x, axes)
+    shape, dt = x.shape, x.dtype
+    flat = x.reshape(-1).astype(jnp.float32)
+    ln = flat.shape[0]
+    pad = (-ln) % (n * block)
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+    chunks = flat.reshape(n, -1)
+    reduced = ring_reduce_scatter(chunks, axes, sizes, axis=0,
+                                  dtype=dtype, block=block)  # (1, c)
+    gathered = ring_all_gather(reduced, axes, sizes, axis=0,
+                               dtype=dtype, block=block)  # (n, c)
+    return gathered.reshape(-1)[:ln].reshape(shape).astype(dt)
+
+
+def make_qgather(axes: Tuple[str, ...], sizes: Tuple[int, ...], dim: int,
+                 param_dtype: str, grad_dtype: str, block: int) -> Callable:
+    """The ZeRO-3 leaf transport as ONE custom_vjp: forward = quantized ring
+    all-gather of the parameter shard along ``dim`` (``param_comm_dtype``),
+    backward = quantized ring reduce-scatter of the cotangent
+    (``grad_comm_dtype``) — exactly the two collectives ZeRO++ quantizes.
+    ``none`` on either side keeps the native exact collective for that
+    direction."""
+
+    def _fwd_impl(shard):
+        return ring_all_gather(shard, axes, sizes, axis=dim,
+                               dtype=param_dtype, block=block)
+
+    @jax.custom_vjp
+    def qg(shard):
+        return _fwd_impl(shard)
+
+    def fwd(shard):
+        return _fwd_impl(shard), None
+
+    def bwd(_res, g):
+        # the cotangent arrives in the primal's (float) dtype, so the
+        # reduce-scattered shard is already shaped and typed like the input
+        return (ring_reduce_scatter(g, axes, sizes, axis=dim,
+                                    dtype=grad_dtype, block=block),)
+
+    qg.defvjp(fwd, bwd)
+    return qg
+
+
+# =========================================================== support checks
+def wants_quant_comm(hp: Optional[HybridParallelConfig]) -> bool:
+    """Whether the strategy asks for the explicit quantized grad-sync path:
+    any layer's grad/param comm dtype is not 'none' AND there is a dp group
+    to communicate over (dp=1 layouts have no grad sync — the knob is
+    inert, which the linter warns about, rather than wrong)."""
+    if hp is None:
+        return False
+    asks = any(
+        getattr(s, "grad_comm_dtype", "none") != "none"
+        or getattr(s, "param_comm_dtype", "none") != "none"
+        for s in hp.layers
+    )
+    if not asks:
+        return False
+    try:
+        return any(hp.dp(i) > 1 for i in range(hp.num_layers))
+    except Exception:
+        return False
+
+
+def quant_comm_reason(model_cfg: Any, hp: HybridParallelConfig, *,
+                      anomaly_guard: Optional[bool] = None) -> Optional[str]:
+    """Why the quantized comm path cannot run this config, or None when it
+    can. Pure host-side (the strategy linter calls it with no tracing);
+    shared verbatim by the GLS013 lint diagnostics and the trace-time
+    refusal so the two can never disagree."""
+    if hp.pp > 1:
+        return "quantized grad sync requires pp=1 (the pipeline engines own " \
+               "their grad schedule)"
+    for i, s in enumerate(hp.layers):
+        if s.tp > 1 or s.cp > 1 or s.sp:
+            return "layer %d: quantized grad sync requires a pure " \
+                   "data-parallel layout (tp=1, cp=1, no ulysses); got " \
+                   "tp=%d cp=%d sp=%d" % (i, s.tp, s.cp, s.sp)
+    if hp.vocab_tp > 1 or hp.vocab_cp > 1 or hp.vocab_sp:
+        return "vocab parallelism (vtp=%d vcp=%d vsp=%d) is not expressible " \
+               "in the manual dp grad ring" % (hp.vocab_tp, hp.vocab_cp, hp.vocab_sp)
+    if hp.default_dp_type == "zero2":
+        return "default_dp_type='zero2' shards the grad accumulator without " \
+               "sharding params; the quantized ring covers ddp and per-layer " \
+               "zero3 (fsdp=1) only"
+    needs_fp8 = any(
+        "fp8_e4m3" in (s.grad_comm_dtype, s.param_comm_dtype) for s in hp.layers
+    ) or hp.tp_comm_quant == "fp8_e4m3"
+    if needs_fp8 and not fp8_supported():
+        return "fp8_e4m3 wire payloads need jax.numpy.float8_e4m3fn, which " \
+               "this jax does not provide"
+    if anomaly_guard:
+        return "the anomaly guard's spike/rollback contract expects the " \
+               "bitwise GSPMD loss; disable it (--anomaly_guard 0) to train " \
+               "with quantized grad sync"
+    return None
+
+
+def assert_quant_comm_supported(model_cfg: Any, hp: HybridParallelConfig, *,
+                                anomaly_guard: Optional[bool] = None) -> None:
+    """Trace-time refusal (GLS013 DiagnosticError) — the loud half of the
+    never-silently-differ contract; strategy_lint reports the same reason
+    pre-trace."""
+    reason = quant_comm_reason(model_cfg, hp, anomaly_guard=anomaly_guard)
+    if reason is not None:
+        from galvatron_tpu.analysis import diagnostics as D
+
+        raise D.DiagnosticError([D.make(
+            "GLS013", "quantized collectives: %s" % reason,
+            key="grad_comm_dtype",
+        )])
+
+
+# ===================================================== grad-sync train path
+def _spec_dp_dim(spec: P, dp_axes: Tuple[str, ...]) -> Optional[int]:
+    """Dim index carrying any of the dp axes in ``spec`` (the ZeRO-3 shard
+    dim), or None for replicated leaves."""
+    dp = set(dp_axes)
+    for i, e in enumerate(spec):
+        names = (e,) if isinstance(e, str) else tuple(e or ())
+        if any(a in dp for a in names):
+            return i
+    return None
+
+
+def _leaf_wire_dtypes(model) -> Dict[str, Any]:
+    """Per-leaf (grad_dtype, param_dtype) trees matching model.param_specs:
+    layer leaves inherit their layer's serialized comm dtypes; embed/head
+    (vocab) leaves stay 'none' — their sync is exact (small, and the loss
+    head is the numerically touchiest part of the model)."""
+    hp = model.hp
+    layer_lists = ("layers", "stages", "enc_layers", "dec_layers", "blocks")
+    out = {}
+    offset = 0
+    for key, sub in model.param_specs.items():
+        if key in layer_lists:
+            per = []
+            for i in range(len(sub)):
+                s = hp.layers[offset + i]
+                per.append(jax.tree.map(
+                    lambda _: (s.grad_comm_dtype, s.param_comm_dtype), sub[i],
+                    is_leaf=lambda t: isinstance(t, P)))
+            out[key] = per
+            offset += len(sub)
+        else:
+            out[key] = jax.tree.map(lambda _: ("none", "none"), sub,
+                                    is_leaf=lambda t: isinstance(t, P))
+    return out
+
+
+def make_quant_loss_and_grads(model) -> Callable:
+    """(params, batch) -> (loss, grads) with the DP gradient sync as an
+    explicit (quantizable) ring.
+
+    One `jax.shard_map` manual over the dp mesh axes wraps the whole
+    loss+grad computation: params enter through their own PartitionSpecs
+    (replicated leaves whole, ZeRO-3 leaves as shards that a `make_qgather`
+    custom_vjp gathers — quantized forward, quantized cotangent
+    reduce-scatter), the batch enters dp-sharded, and the body runs the
+    family's constraint-free local loss (models/base with hp=None) under
+    ``value_and_grad``. Microbatches (hp.chunks) are weighted by their
+    share of the GLOBAL valid-token count (one cheap scalar psum), so the
+    objective is identical to the GSPMD step's; replicated-leaf grads are
+    summed by `ring_all_reduce` at each leaf's ``grad_comm_dtype``
+    ('none' leaves ride exact lax.psum). Grads come out in the exact
+    shardings ``grad_accum_specs`` expects, so the optimizer update stays
+    the ordinary GSPMD program."""
+    hp, mesh, cfg = model.hp, model.mesh, model.cfg
+    local_loss = getattr(model, "local_loss_fn", None)
+    if local_loss is None:
+        from galvatron_tpu.analysis import diagnostics as D
+
+        raise D.DiagnosticError([D.make(
+            "GLS013", "quantized collectives: this model family has no "
+            "constraint-free local loss path (custom param trees / custom "
+            "loss_fn); quantized grad sync supports the base transformer "
+            "families", key="grad_comm_dtype",
+        )])
+    assert_quant_comm_supported(cfg, hp)
+    from galvatron_tpu.parallel.mesh import layer_axes
+
+    dp_axes = tuple(layer_axes(hp, 0).dp)
+    sizes = tuple(mesh.shape[a] for a in dp_axes)
+    n = int(np.prod(sizes))
+    block = int(hp.comm_quant_block)
+    chunks = max(int(hp.chunks), 1)
+
+    p_specs = model.param_specs
+    wires = _leaf_wire_dtypes(model)
+    is_spec = lambda t: isinstance(t, P)  # noqa: E731
+
+    # per-leaf transport plan, precomputed outside the traced body. A plain
+    # tuple (not a dict: the param tree's interior nodes are dicts, so an
+    # is_leaf=dict test would swallow the whole tree as one leaf); wrapped
+    # as a static leaf via a 1-tuple-free flatten over the SPEC tree, whose
+    # leaf order matches jax.tree.flatten of the params.
+    def leaf_plan(spec, wire):
+        gdt, pdt = wire
+        return (_spec_dp_dim(spec, dp_axes), gdt, pdt)
+
+    spec_leaves = jax.tree.leaves(p_specs, is_leaf=is_spec)
+    wire_leaves = jax.tree.leaves(wires, is_leaf=lambda t: isinstance(t, tuple))
+    plan_leaves = [leaf_plan(s, w) for s, w in zip(spec_leaves, wire_leaves)]
+
+    def body(params_loc, batch_loc):
+        # gather zero3 leaves through the custom_vjp transport; the same
+        # function is reapplied per microbatch inside value_and_grad so the
+        # backward reduce-scatter fires exactly where ZeRO flushes grads
+        def gather_tree(p):
+            leaves, treedef = jax.tree.flatten(p)
+            out = []
+            for leaf, (dim, gdt, pdt) in zip(leaves, plan_leaves, strict=True):
+                if dim is None:
+                    out.append(leaf)
+                else:
+                    out.append(make_qgather(dp_axes, sizes, dim, pdt, gdt,
+                                            block)(leaf))
+            return jax.tree.unflatten(treedef, out)
+
+        # microbatch weights: each (shard, microbatch) loss is a mean over
+        # its own valid tokens; weighting by its share of the GLOBAL valid
+        # count keeps the objective identical to the GSPMD chunks loop
+        def split(x):
+            return x.reshape((chunks, x.shape[0] // chunks) + x.shape[1:])
+
+        mbs = jax.tree.map(split, batch_loc)
+        if "loss_mask" in batch_loc:
+            counts = jnp.sum(
+                mbs["loss_mask"].astype(jnp.float32),
+                axis=tuple(range(1, batch_loc["loss_mask"].ndim + 1)))
+        else:
+            some = jax.tree.leaves(batch_loc)[0]
+            counts = jnp.full((chunks,), some.shape[0] / chunks, jnp.float32)
+        total = jax.lax.psum(jnp.sum(counts), dp_axes)
+        weights = counts / jnp.maximum(total, 1.0)
+
+        grads = None
+        loss = jnp.float32(0.0)
+        for c in range(chunks):
+            mb = jax.tree.map(lambda x: x[c], mbs)
+            w = weights[c]
+
+            def weighted(p, _mb=mb, _w=w):
+                return (_w * local_loss(gather_tree(p), _mb)).astype(jnp.float32)
+
+            l, g = jax.value_and_grad(weighted)(params_loc)
+            grads = g if grads is None else jax.tree.map(jnp.add, grads, g)
+            loss = loss + l
+        loss = jax.lax.psum(loss, dp_axes)
+
+        # replicated-leaf sync: the explicit quantized ring (zero3 leaves
+        # were reduce-scattered by the qgather transpose already)
+        g_leaves, treedef = jax.tree.flatten(grads)
+        out = []
+        for leaf, (dim, gdt, _pdt) in zip(g_leaves, plan_leaves, strict=True):
+            if dim is not None:
+                out.append(leaf)  # reduce-scattered by the qgather transpose
+            elif gdt == "none" or n == 1:
+                out.append(jax.lax.psum(leaf, dp_axes) if n > 1 else leaf)
+            else:
+                out.append(ring_all_reduce(leaf, dp_axes, sizes,
+                                           dtype=gdt, block=block))
+        return loss, jax.tree.unflatten(treedef, out)
+
+    def loss_and_grads(params, batch):
+        batch_specs = model.batch_specs(batch)
+        return jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(p_specs, batch_specs),
+            out_specs=(P(), p_specs),
+            axis_names=set(dp_axes),
+        )(params, batch)
+
+    return loss_and_grads
+
+
+# ============================================================= measurement
+def bytes_on_wire_mb(hp: HybridParallelConfig, param_mb_per_layer: float) -> Dict[str, float]:
+    """Estimated per-step gradient-sync traffic in MB (sum over layers of
+    ring volume x wire bytes), fp32-grads baseline vs the strategy's comm
+    dtypes — the bench's bytes-on-wire estimate and the README's worked
+    numbers come from here."""
+    out = {"fp32": 0.0, "configured": 0.0}
+    for i, s in enumerate(hp.layers):
+        d = hp.dp(i)
+        if d <= 1:
+            continue
+        ring = 2.0 * (d - 1) / d
+        out["fp32"] += ring * param_mb_per_layer
+        out["configured"] += ring * param_mb_per_layer * (
+            wire_bytes_per_element(s.grad_comm_dtype, hp.comm_quant_block) / 4.0)
+    return {k: round(v, 3) for k, v in out.items()}
+
+
+def measure_quant_overhead_ms(shape=(1 << 18,), dtype: str = "int8",
+                              block: int = 64, iters: int = 5) -> float:
+    """Wall-clock of one jitted quantize+dequantize round trip over a
+    ``shape`` fp32 buffer — the per-pass overhead coefficient the
+    TimeCostModel's comm-precision axis charges (ms; profiling helper for
+    the hardware profiler and the quant_comm telemetry event, never on the
+    training hot path)."""
+    import time as _time
+
+    x = jnp.arange(int(np.prod(shape)), dtype=jnp.float32).reshape(shape) * 1e-3
+
+    @jax.jit
+    def roundtrip(v):
+        p, sc = quantize_blockwise(v, dtype, block)
+        return dequantize_blockwise(p, sc, v.shape)
+
+    jax.block_until_ready(roundtrip(x))  # galv-lint: ignore[GLC005] -- timing harness: the sync IS the measurement
+    ts = []
+    for _ in range(max(iters, 1)):
+        t0 = _time.perf_counter()
+        jax.block_until_ready(roundtrip(x))  # galv-lint: ignore[GLC005] -- timing harness: the sync IS the measurement
+        ts.append(_time.perf_counter() - t0)
+    return min(ts) * 1e3

@@ -153,33 +153,92 @@ def _flat_axis_index(axis_names: Tuple[str, ...], sizes: Tuple[int, ...]):
     return idx
 
 
+# ------------------------------------------------------- quantized payloads
+def _q_encode(x, quant):
+    """Wire-encode a ring payload: (payload, scales) under a quantized
+    tp_comm_quant, a bf16 cast for 'bf16', the array itself for None/'none'.
+    Encoded ONCE before a rotation — the payload stays encoded through every
+    hop and each consumer dequantizes only the block it multiplies
+    (EQuARX-style: the wire carries int8, the MXU sees fp)."""
+    from galvatron_tpu.parallel import quant_collectives as QC
+
+    if quant is None or quant[0] == "none":
+        return x
+    dtype, block = quant
+    if dtype == "bf16":
+        return x.astype(jnp.bfloat16)
+    return QC.quantize_blockwise(x, dtype, block) + (x.shape, x.dtype)
+
+
+def _q_decode(enc, quant):
+    from galvatron_tpu.parallel import quant_collectives as QC
+
+    if quant is None or quant[0] == "none":
+        return enc
+    if quant[0] == "bf16":
+        return enc  # bf16 feeds the matmul directly
+    payload, scales, shape, dt = enc
+    return QC.dequantize_blockwise(payload, scales, shape, dt)
+
+
+def _q_permute(enc, quant, tp_axes, perm):
+    if quant is None or quant[0] in ("none", "bf16"):
+        return jax.lax.ppermute(enc, tp_axes, perm)
+    payload, scales, shape, dt = enc
+    return (jax.lax.ppermute(payload, tp_axes, perm),
+            jax.lax.ppermute(scales, tp_axes, perm), shape, dt)
+
+
 # --------------------------------------------------- column-parallel matmul
-def _col_matmul_chunks(x, w, *, tp_axes, n, sizes):
+def _col_matmul_chunks(x, w, *, tp_axes, n, sizes, quant=None):
     """Decomposed all-gather + matmul: x (B, s, H) is this device's
     megatron-sp seq shard; w (H, ...) its column shard. Each ring step
     matmuls the block currently held and places it at the block's global
     seq offset, then rotates — the python-unrolled loop lets XLA overlap
     each step's ppermute with the previous block's matmul, exactly as the
-    ring-attention forward does. Returns (B, n*s, ...)."""
+    ring-attention forward does. Under ``quant`` the rotating activation is
+    wire-encoded once (int8/fp8 blockwise or bf16) and every hop moves the
+    encoded payload; each step dequantizes only the block it consumes.
+    Returns (B, n*s, ...)."""
     b, s = x.shape[0], x.shape[1]
     tail = w.shape[1:]
     idx = _flat_axis_index(tp_axes, sizes)
     out = jnp.zeros((b, n * s) + tail, x.dtype)
     perm = _ring_perm(n)
-    x_cur = x
+    x_cur = _q_encode(x, quant)
     for step in range(n):
         src = jnp.mod(idx - step, n)  # whose block x_cur originally was
-        blk = jnp.einsum("bsh,h...->bs...", x_cur, w)
+        blk = jnp.einsum("bsh,h...->bs...", _q_decode(x_cur, quant), w)
         out = jax.lax.dynamic_update_slice(
-            out, blk, (jnp.int32(0), src * s) + (jnp.int32(0),) * len(tail))
+            out, blk.astype(x.dtype),
+            (jnp.int32(0), src * s) + (jnp.int32(0),) * len(tail))
         if step < n - 1:
-            x_cur = jax.lax.ppermute(x_cur, tp_axes, perm)
+            x_cur = _q_permute(x_cur, quant, tp_axes, perm)
     return out
 
 
-def _col_matmul_dense(x, w, *, tp_axes, n, sizes):
+def _col_matmul_dense(x, w, *, tp_axes, n, sizes, quant=None):
     """Undecomposed manual form (mode='shard_map'): one all-gather, one
-    matmul — visible collectives, no overlap."""
+    matmul — visible collectives, no overlap. Under ``quant`` the activation
+    is wire-encoded before the gather (the all-gather moves payload+scales)
+    and dequantized once on arrival."""
+    if quant is not None and quant[0] not in ("none",):
+        from galvatron_tpu.parallel import quant_collectives as QC
+
+        dtype, block = quant
+        if dtype == "bf16":
+            x_full = jax.lax.all_gather(
+                x.astype(jnp.bfloat16), tp_axes, axis=1, tiled=True)
+            return jnp.einsum("bsh,h...->bs...", x_full, w)
+        payload, scales = QC.quantize_blockwise(x, dtype, block)
+        pg = jax.lax.all_gather(payload, tp_axes)   # (n, nblk, block)
+        sg = jax.lax.all_gather(scales, tp_axes)    # (n, nblk)
+        parts = QC.dequantize_blockwise(
+            pg.reshape(-1, pg.shape[-1]), sg.reshape(-1),
+            (n,) + x.shape, x.dtype)
+        x_full = jnp.moveaxis(parts, 0, 1).reshape(
+            x.shape[0], n * x.shape[1], x.shape[2])
+        return jnp.einsum("bsh,h...->bs...", x_full, w)
     del n, sizes
     x_full = jax.lax.all_gather(x, tp_axes, axis=1, tiled=True)
     return jnp.einsum("bsh,h...->bs...", x_full, w)
@@ -210,13 +269,17 @@ def _col_bwd_chunks(x, w, g, *, tp_axes, n, sizes):
 
 
 # ------------------------------------------------------ row-parallel matmul
-def _row_matmul_chunks(x, w, *, tp_axes, n, sizes):
+def _row_matmul_chunks(x, w, *, tp_axes, n, sizes, quant=None):
     """Decomposed matmul + reduce-scatter: x (B, S, f) full-seq with f the
     row shard, w (f, H). A ring accumulator destined for device d starts at
     d+1 and hops +1 each step picking up that device's partial for block d;
     after n-1 hops it lands home fully reduced. Each step's chunk matmul
-    overlaps the accumulator's ppermute. Returns the megatron-sp shard
-    (B, S/n, H)."""
+    overlaps the accumulator's ppermute. Under ``quant`` each accumulator
+    hop is wire-encoded (re-quantized per hop — the partial sums change) and
+    the running sum stays in the compute dtype, the ZeRO++ reduce-scatter
+    discipline. Returns the megatron-sp shard (B, S/n, H)."""
+    from galvatron_tpu.parallel.quant_collectives import _wire_hop
+
     s = x.shape[1] // n
     idx = _flat_axis_index(tp_axes, sizes)
     perm = _ring_perm(n)
@@ -225,12 +288,21 @@ def _row_matmul_chunks(x, w, *, tp_axes, n, sizes):
         dest = jnp.mod(idx - 1 - step, n)
         x_blk = jax.lax.dynamic_slice_in_dim(x, dest * s, s, 1)
         part = jnp.einsum("bsf,fh->bsh", x_blk, w)
-        acc = part if acc is None else jax.lax.ppermute(acc, tp_axes, perm) + part
+        if acc is None:
+            acc = part
+        elif quant is None or quant[0] == "none":
+            acc = jax.lax.ppermute(acc, tp_axes, perm) + part
+        else:
+            acc = _wire_hop(acc, tp_axes, perm, quant[0], quant[1]).astype(
+                part.dtype) + part
     return acc
 
 
-def _row_matmul_dense(x, w, *, tp_axes, n, sizes):
-    del n, sizes
+def _row_matmul_dense(x, w, *, tp_axes, n, sizes, quant=None):
+    # psum_scatter reduces inside the collective — there is no payload seam
+    # to quantize, so the 'shard_map' mode's row matmul stays full-precision
+    # (the linter documents this asymmetry; 'overlap' quantizes both rings)
+    del n, sizes, quant
     part = jnp.einsum("bsf,fh->bsh", x, w)
     return jax.lax.psum_scatter(part, tp_axes, scatter_dimension=1, tiled=True)
 
@@ -260,12 +332,16 @@ def _row_bwd_chunks(x, w, g, *, tp_axes, n, sizes):
 
 
 def make_col_matmul(tp_axes: Tuple[str, ...], n: int, sizes: Tuple[int, ...], *,
-                    mode: str, use_custom_vjp: bool = True):
+                    mode: str, use_custom_vjp: bool = True, quant=None):
     """(x_shard (B,s,H), w_shard (H,...)) -> (B,S,...). With `use_custom_vjp`
     the overlap mode attaches the hand-scheduled ring backward; the autodiff
     fallback (the tests' parity oracle, as in ring_attention) differentiates
-    the unrolled forward."""
-    kw = dict(tp_axes=tuple(tp_axes), n=n, sizes=tuple(sizes))
+    the unrolled forward. ``quant`` = (wire dtype, block) quantizes the
+    FORWARD ring payload (tp_comm_quant); the hand-scheduled backward keeps
+    full-precision cotangent rings — the straight-through convention, so
+    gradients are taken as if the forward wire were exact."""
+    kw = dict(tp_axes=tuple(tp_axes), n=n, sizes=tuple(sizes), quant=quant)
+    bkw = dict(tp_axes=tuple(tp_axes), n=n, sizes=tuple(sizes))
     fwd_impl = _col_matmul_dense if mode == "shard_map" else _col_matmul_chunks
     if mode == "shard_map" or not use_custom_vjp:
         return partial(fwd_impl, **kw)
@@ -275,14 +351,15 @@ def make_col_matmul(tp_axes: Tuple[str, ...], n: int, sizes: Tuple[int, ...], *,
         return _col_matmul_chunks(x, w, **kw)
 
     col.defvjp(lambda x, w: (_col_matmul_chunks(x, w, **kw), (x, w)),
-               lambda res, g: _col_bwd_chunks(*res, g, **kw))
+               lambda res, g: _col_bwd_chunks(*res, g, **bkw))
     return col
 
 
 def make_row_matmul(tp_axes: Tuple[str, ...], n: int, sizes: Tuple[int, ...], *,
-                    mode: str, use_custom_vjp: bool = True):
+                    mode: str, use_custom_vjp: bool = True, quant=None):
     """(x (B,S,f), w (f,H)) -> (B,s,H); see make_col_matmul."""
-    kw = dict(tp_axes=tuple(tp_axes), n=n, sizes=tuple(sizes))
+    kw = dict(tp_axes=tuple(tp_axes), n=n, sizes=tuple(sizes), quant=quant)
+    bkw = dict(tp_axes=tuple(tp_axes), n=n, sizes=tuple(sizes))
     fwd_impl = _row_matmul_dense if mode == "shard_map" else _row_matmul_chunks
     if mode == "shard_map" or not use_custom_vjp:
         return partial(fwd_impl, **kw)
@@ -292,7 +369,7 @@ def make_row_matmul(tp_axes: Tuple[str, ...], n: int, sizes: Tuple[int, ...], *,
         return _row_matmul_chunks(x, w, **kw)
 
     row.defvjp(lambda x, w: (_row_matmul_chunks(x, w, **kw), (x, w)),
-               lambda res, g: _row_bwd_chunks(*res, g, **kw))
+               lambda res, g: _row_bwd_chunks(*res, g, **bkw))
     return row
 
 
@@ -341,6 +418,23 @@ def manual_layer_forward(
     if mode not in ("shard_map", "overlap"):
         raise ValueError("manual_layer_forward mode must be 'shard_map' or "
                          "'overlap', got %r" % mode)
+    # tp_comm_quant: wire-encode the ring payloads (ROADMAP item 2 /
+    # EQuARX); fp8 without runtime support refuses loudly (GLS013), the
+    # never-silently-differ contract
+    quant = None
+    tp_quant = getattr(hp, "tp_comm_quant", "none") if hp is not None else "none"
+    if tp_quant != "none":
+        from galvatron_tpu.parallel import quant_collectives as QC
+
+        if tp_quant == "fp8_e4m3" and not QC.fp8_supported():
+            from galvatron_tpu.analysis import diagnostics as D
+
+            raise D.DiagnosticError([D.make(
+                "GLS013", "tp_comm_quant='fp8_e4m3' needs "
+                "jax.numpy.float8_e4m3fn, which this jax does not provide",
+                key="tp_comm_quant",
+            )])
+        quant = (tp_quant, int(getattr(hp, "comm_quant_block", 64)))
     tp_axes = tuple(axes.tp)
     n = mesh_axis_size(mesh, tp_axes)
     sizes = tuple(mesh.shape[a] for a in tp_axes)
@@ -352,9 +446,9 @@ def manual_layer_forward(
 
     def body(lp, xs, pos, bias):
         col = make_col_matmul(tp_axes, n, sizes, mode=mode,
-                              use_custom_vjp=use_custom_vjp)
+                              use_custom_vjp=use_custom_vjp, quant=quant)
         row = make_row_matmul(tp_axes, n, sizes, mode=mode,
-                              use_custom_vjp=use_custom_vjp)
+                              use_custom_vjp=use_custom_vjp, quant=quant)
 
         from galvatron_tpu.models.base import _activation, _norm
         from galvatron_tpu.ops.attention import core_attention

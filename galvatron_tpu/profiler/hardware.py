@@ -321,6 +321,24 @@ class HardwareProfiler:
         coe = t_both / max(max(t_comp, t_comm), 1e-9)
         return {"overlap_coe": round(float(np.clip(coe, 1.0, 2.0)), 4)}
 
+    def profile_quant_overhead(self) -> Dict[str, float]:
+        """Quantize+dequantize toll per fp32-MB per collective pass (ms/MB)
+        — the comm-precision axis's compute coefficient
+        (TimeCostModel.quant_overhead_ms; parallel/quant_collectives.py
+        blockwise kernels). Measured at end_mb so the fixed jit-dispatch
+        cost amortises; written into the overlap config, whose parser
+        (cost_model_args.parse_hardware_profiles) carries it into the
+        search engine."""
+        from galvatron_tpu.parallel.quant_collectives import (
+            measure_quant_overhead_ms,
+        )
+
+        mb = max(self.args.end_mb, 1.0)
+        n_elems = int(mb * 1024 * 1024 / 4)
+        ms = measure_quant_overhead_ms((n_elems,), dtype="int8",
+                                       iters=self.args.iters)
+        return {"quant_overhead_coe": round(ms / mb, 5)}
+
     # ------------------------------------------------------------------- files
     def config_paths(self) -> Dict[str, str]:
         d = self.args.config_dir
@@ -343,6 +361,9 @@ class HardwareProfiler:
             "overlap": self.profile_overlap(),
             "dcn": self.profile_dcn_bandwidth(),
         }
+        # the quant toll rides the overlap config file (both are scalar
+        # coefficient dicts the same parser consumes)
+        results["overlap"].update(self.profile_quant_overhead())
         if write:
             paths = self.config_paths()
             os.makedirs(self.args.config_dir, exist_ok=True)

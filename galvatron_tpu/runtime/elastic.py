@@ -364,8 +364,12 @@ def resolve_resume_strategy(
         scan_layers=getattr(args, "scan_layers", True),
         remat_policy=getattr(args, "remat_policy", "full"),
         tp_comm_mode=getattr(args, "tp_comm_mode", "gspmd"),
+        tp_comm_quant=getattr(args, "tp_comm_quant", "none"),
         mixed_precision=getattr(args, "mixed_precision", "bf16"),
     )
+    # NB grad/param comm dtypes + comm_quant_block are serialized per-layer
+    # strategy fields, so they ride prov["strategy"] through resume,
+    # re-search fallback excepted (a re-searched strategy starts at 'none')
     saved_hp = HybridParallelConfig.from_json(
         dict(prov["strategy"]), world_size=saved_world, **exec_kw)
     budget = getattr(args, "elastic_memory_gb", None) or prov.get(
@@ -474,6 +478,7 @@ def resolve_migration_strategy(
         scan_layers=current_hp.scan_layers,
         remat_policy=current_hp.remat_policy,
         tp_comm_mode=current_hp.tp_comm_mode,
+        tp_comm_quant=current_hp.tp_comm_quant,
         mixed_precision=current_hp.mixed_precision,
     )
     budget = getattr(args, "elastic_memory_gb", None) or DEFAULT_MEMORY_GB

@@ -56,6 +56,12 @@ class HybridParallelModel:
     # loss for evaluation: under the 1f1b engines, loss_fn is the grad-bearing
     # schedule (loss and grads come out of one scan, so XLA cannot DCE the
     # backward); this is the cheap path (reference evaluation is forward-only)
+    local_loss_fn: Optional[Callable] = None  # the CONSTRAINT-FREE local
+    # loss (models/base loss_fns with hp=None/mesh=None): the body of the
+    # quantized grad-sync shard_map (parallel/quant_collectives.py), where
+    # each dp shard computes grads on its local batch with no
+    # with_sharding_constraint in scope. Base families only; None refuses
+    # the quantized path with GLS013.
     # memoized NamedSharding trees per batch signature (key set + ranks), so
     # the per-step shard_batch is ONE device_put of the whole tree with no
     # per-key NamedSharding construction on the hot path
@@ -207,6 +213,19 @@ class HybridParallelModel:
         chunks = 1 if hp.pp > 1 else hp.chunks
         accum_shardings = self.shardings(self.grad_accum_specs())
 
+        # quantized comm-precision path (parallel/quant_collectives.py): the
+        # strategy's per-layer grad/param comm dtypes route the whole
+        # loss+grad computation through the explicit shard_map grad ring.
+        # Unsupported configs refuse with GLS013 here (and at lint time);
+        # the guard combination is part of that refusal contract.
+        quant_fn = None
+        from galvatron_tpu.parallel import quant_collectives as QC
+
+        if self.grad_fn is None and QC.wants_quant_comm(hp):
+            QC.assert_quant_comm_supported(self.cfg, hp,
+                                           anomaly_guard=guard_anomalies)
+            quant_fn = QC.make_quant_loss_and_grads(self)
+
         def train_step(params, opt_state, batch, spike_cap=None):
             def mb_loss(p, mb):
                 return self.loss_fn(p, mb)
@@ -232,6 +251,15 @@ class HybridParallelModel:
                     out.append(g)
                     prev = g
                 grads = jax.tree.unflatten(treedef, out)
+            elif quant_fn is not None:
+                # explicit quantized grad sync: microbatching and the dp
+                # reduction happen inside the shard_map body; the grads come
+                # out already in the accumulator shardings (the constraints
+                # below are no-ops that keep the update program identical)
+                loss, grads = quant_fn(params, batch)
+                grads = jax.tree.map(
+                    lambda g, s: jax.lax.with_sharding_constraint(g, s), grads, accum_shardings
+                )
             elif chunks == 1:
                 loss, grads = jax.value_and_grad(mb_loss)(params, batch)
                 grads = jax.tree.map(
@@ -313,6 +341,7 @@ def construct_hybrid_parallel_model(
     specs = M.model_param_specs(cfg, hp)
     grad_fn = None
     eval_loss = None
+    local_loss = None
     if hp.pp > 1 and hp.pipeline_type == "pipedream_flush":
         from galvatron_tpu.parallel import pipeline_1f1b
         from galvatron_tpu.parallel.pipeline import (
@@ -348,12 +377,18 @@ def construct_hybrid_parallel_model(
             p, b.get("pixels", b.get("tokens")), b.get("positions"), cfg, hp, mesh,
             attn_mask=b.get("attn_mask"),
         )
+        local_loss = lambda p, b: M.classification_loss_fn(p, b, cfg)
     else:
         base_loss = lambda p, b: M.lm_loss_fn(p, b, cfg, hp, mesh)
         fwd = lambda p, b: M.model_forward(
             p, b["tokens"], b["positions"], cfg, hp, mesh,
             token_type_ids=b.get("token_type_ids"), attn_mask=b.get("attn_mask"),
         )
+        local_loss = lambda p, b: M.lm_loss_fn(p, b, cfg)
+    if hp.pp > 1 or loss_fn is not None:
+        # custom losses have no constraint-free local form; pp>1 never takes
+        # the quantized path (GLS013)
+        local_loss = None
     return HybridParallelModel(
         cfg=cfg,
         hp=hp,
@@ -363,4 +398,5 @@ def construct_hybrid_parallel_model(
         forward_fn=fwd,
         grad_fn=grad_fn,
         eval_loss_fn=None if loss_fn is not None else eval_loss,
+        local_loss_fn=local_loss,
     )

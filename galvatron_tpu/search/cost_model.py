@@ -45,6 +45,19 @@ def _info(strategy) -> dict:
     return strategy[3] if len(strategy) > 3 else {}
 
 
+def _wire_bytes(dtype: str, block: int, full_bytes: float) -> float:
+    """Bytes per gradient/param element on the wire for one collective pass
+    under a comm-precision choice (mirrors
+    parallel/quant_collectives.wire_bytes_per_element; kept inline so the
+    search engine stays jax-free): quantized payloads carry 1 byte plus the
+    fp32 per-block scale amortised over the block."""
+    if dtype == "bf16":
+        return 2.0
+    if dtype in ("int8", "fp8_e4m3"):
+        return 1.0 + 4.0 / max(int(block), 1)
+    return full_bytes
+
+
 def _eval_fit(profile: Any, x: float) -> float:
     """Evaluate a profiled quantity: scalar, (m, c) linear fit, or
     (a, b, c) quadratic fit."""
@@ -167,6 +180,18 @@ class MemoryCostModel:
                 self.model_states_size *= self.zero3_ratio(self.sdp_size)
             elif pa.use_zero2_for_dp:
                 self.model_states_size *= self.zero2_ratio(self.sdp_size)
+
+        # ---- comm-precision buffers (quantized collectives) ----------------
+        # wire payload + per-block fp32 scales live alongside the fp32 value
+        # during a quantized sync: one layer's grads for 'gcd', the gathered
+        # compute copy's payload for 'pcd' (ZeRO-3 gather)
+        qblock = int(getattr(pa, "comm_quant_block", 64) or 64)
+        self.quant_buffer_mb = 0.0
+        for dt in (info.get("gcd", "none"), info.get("pcd", "none")):
+            if dt in ("int8", "fp8_e4m3"):
+                self.quant_buffer_mb += self.parameter_size * (
+                    1.0 + 4.0 / max(qblock, 1)) / 4.0
+        self.model_states_size += self.quant_buffer_mb
 
         # ---- activations (scan-pipeline accounting, see module docstring) --
         act = pma.tp_activation_per_bsz_dict
@@ -351,20 +376,43 @@ class TimeCostModel:
             self.bct += self.fct  # recompute
 
         # ---- dp (grad reduce) comm ---------------------------------------
+        # comm-precision axis (ROADMAP item 2): the strategy's per-layer
+        # wire dtypes scale the bytes actually moved — grad sync by 'gcd',
+        # the ZeRO-3 weight gather by 'pcd' — and quantized payloads pay a
+        # quantize/dequantize toll per pass (quant_overhead_coe), so a
+        # compute-dominated profile keeps fp32 while a bandwidth-dominated
+        # one flips to int8 (the search test pins both directions).
+        self.grad_comm_dtype = str(info.get("gcd", "none"))
+        self.param_comm_dtype = str(info.get("pcd", "none"))
+        qblock = int(getattr(pa, "comm_quant_block", 64) or 64)
+        full_bytes = 2.0 if ta.mixed_precision else 4.0
+        grad_wire = _wire_bytes(self.grad_comm_dtype, qblock, full_bytes)
+        param_wire = _wire_bytes(self.param_comm_dtype, qblock, full_bytes)
         sdp = self.tp_size * self.dp_size if self.ulysses else self.dp_size
         param_mb = ma.parameter_size if self.ulysses else ma.parameter_size / self.tp_size
-        self.dp_message_size = 2 * (sdp - 1) / max(sdp, 1) * param_mb * self.layer_num
-        if ta.mixed_precision:
-            self.dp_message_size /= 2
+        # fp32-parameter-MB ring volume; the wire dtype scales actual bytes
+        base_msg = 2 * (sdp - 1) / max(sdp, 1) * param_mb * self.layer_num
+        self.dp_message_size = base_msg * grad_wire / 4.0
+        self.quant_overhead_ms = 0.0
+        qcoe = getattr(pha, "quant_overhead_coe", 0.0) or 0.0
+        if self.grad_comm_dtype in ("int8", "fp8_e4m3") and sdp > 1:
+            # quantize+dequant once for the reduce-scatter wire and once for
+            # the all-gather of the reduced shard (ZeRO++ schedule)
+            self.quant_overhead_ms += qcoe * 2.0 * param_mb * self.layer_num
         self.no_comm = no_comm
         if no_comm:
             self.dp_message_size = 0.0
+            self.quant_overhead_ms = 0.0
         # dp rides the axes tp doesn't occupy: consecutive tp => dp on major
         # axes ('_0' placement) and vice versa
         self.dc = comm_coe(pha.comm_coe_dict, sdp,
                            consec=(not self.consec) if (self.tp_size > 1 and self.dp_size > 1 and not self.ulysses) else True)
         self.dc_overlap = self.dc * pha.dp_overlap_coe
-        self.fsdp_allgather_message_size = self.dp_message_size * 0.5
+        self.fsdp_allgather_message_size = (
+            0.5 * base_msg * param_wire / 4.0 if not no_comm else 0.0)
+        if self.fsdp and self.param_comm_dtype in ("int8", "fp8_e4m3") \
+                and sdp > 1 and not no_comm:
+            self.quant_overhead_ms += qcoe * param_mb * self.layer_num
         self.pha, self.ta, self.pa = pha, ta, pa
 
         # ---- tp collectives ----------------------------------------------
@@ -461,6 +509,9 @@ class TimeCostModel:
                 half = self.fsdp_allgather_message_size * self.dc / 2.0
                 fwd += half
                 bwd += half
+            # quantize/dequantize toll of the comm-precision axis rides the
+            # backward beside the grad sync it belongs to
+            bwd += self.quant_overhead_ms
             fwd += self.cp_communication_time / 3.0
             bwd += self.cp_communication_time * 2.0 / 3.0
             if self.pp_size > 1 and self.p2p_comm_coe:

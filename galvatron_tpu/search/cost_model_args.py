@@ -37,6 +37,10 @@ class ParallelArgs:
     pipeline_type: str = "gpipe"
     optimal_chunk_func: Optional[Callable] = None
     chunks: Optional[int] = None
+    # blockwise-quantization block size for the comm-precision axis
+    # (strategy info keys 'gcd'/'pcd'; parallel/quant_collectives.py):
+    # prices the per-block fp32 scale overhead on the wire
+    comm_quant_block: int = 64
 
 
 @dataclass
@@ -66,6 +70,11 @@ class ProfileHardwareArgs:
     # per-degree collective time tables: {deg: {"popt": (m, c)}} in ms vs MB
     allreduce_dict: Dict[int, Dict[str, Any]] = field(default_factory=dict)
     all2all_dict: Dict[int, Dict[str, Any]] = field(default_factory=dict)
+    # quantize+dequantize cost per fp32-MB per collective pass (ms/MB) —
+    # the comm-precision axis's compute toll, measurable by the hardware
+    # profiler (profiler/hardware.profile_quant_overhead); on a
+    # compute-dominated profile this is what makes fp32 win the search
+    quant_overhead_coe: float = 0.02
 
 
 def default_optimal_chunk_func(local_bsz, strategy, mbsz, min_tp):
@@ -115,4 +124,8 @@ def parse_hardware_profiles(
         "overlap_coe": float((overlap_config or {}).get("overlap_coe", 1.1)),
         "allreduce_dict": {int(k): v for k, v in ((sp_time_config or {}).get("allreduce", {})).items()},
         "all2all_dict": {int(k): v for k, v in ((sp_time_config or {}).get("all2all", {})).items()},
+        # measured quant/dequant toll (ms per fp32-MB per pass), written by
+        # profile_quant_overhead into the overlap config; analytic default
+        "quant_overhead_coe": float(
+            (overlap_config or {}).get("quant_overhead_coe", 0.02)),
     }

@@ -344,6 +344,14 @@ class DpOnModel:
                 ij = info(sj)
                 if self._match_except(si, sj, ["sp"]) and ij.get("sp", 0):
                     cost[i, j] = 1e-10
+                # comm-precision twins share a layout: zero resharding, tiny
+                # ordered bias so equal-cost runs settle deterministically
+                # on the quantized variant
+                if self._match_except(si, sj, ["gcd", "pcd"]) and (
+                    ij.get("gcd", "none") != "none"
+                    or ij.get("pcd", "none") != "none"
+                ):
+                    cost[i, j] = 5e-10
                 if self._match_except(si, sj, ["fsdp"]) and ij.get("fsdp", 0):
                     cost[i, j] = 1e-9
                 if self._match_except(si, sj, ["cpt"]) and ij.get("cpt", 0):

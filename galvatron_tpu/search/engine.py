@@ -11,6 +11,7 @@ property; SURVEY.md §4)."""
 
 from __future__ import annotations
 
+import math
 import os
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
@@ -70,6 +71,13 @@ class SearchArgs:
     parallel_search: bool = False  # thread-parallel outer loop (--parallel_search)
     log_dir: Optional[str] = None  # per-task search log files (reference
     # search_engine.py:379-382 get_thread_logger); None = no file logging
+    # comm-precision axis (ROADMAP item 2): "off" keeps the classic space;
+    # a wire dtype adds, for every pure-dp strategy, a variant whose grad
+    # sync (and zero3 gather under fsdp) uses that payload — the per-layer
+    # DP then picks precision layer by layer under the accuracy budget
+    comm_quant: str = "off"  # off | bf16 | int8 | fp8_e4m3
+    comm_quant_block: int = 64
+    comm_quant_budget: float = 1.0  # max fraction of layers quantized
 
 
 class _TaskLog:
@@ -168,6 +176,19 @@ def generate_strategies(world_size: int, args: SearchArgs) -> List[list]:
                                 if cp > 1:
                                     info["cp"] = cp
                                 strategies.append([pp, tp, dp, info])
+                                # comm-precision variant (ROADMAP item 2):
+                                # only where the quantized ring can run —
+                                # pure data parallel with a dp group to talk
+                                # over (parallel/quant_collectives.py's
+                                # support contract, mirrored by GLS013)
+                                if (args.comm_quant != "off" and pp == 1
+                                        and tp == 1 and cp == 1 and not spf
+                                        and dp > 1):
+                                    qinfo = dict(info)
+                                    qinfo["gcd"] = args.comm_quant
+                                    if fsdp:
+                                        qinfo["pcd"] = args.comm_quant
+                                    strategies.append([pp, tp, dp, qinfo])
     # dedupe
     seen, out = set(), []
     for s in strategies:
@@ -279,6 +300,7 @@ class GalvatronSearchEngine:
         self.overlap_coe = hwp["overlap_coe"]
         self.allreduce_dict = hwp["allreduce_dict"]
         self.all2all_dict = hwp["all2all_dict"]
+        self.quant_overhead_coe = hwp.get("quant_overhead_coe", 0.02)
 
     # ------------------------------------------------------------- arg bundles
     def _bundles(self, chunks: Optional[int]):
@@ -302,6 +324,7 @@ class GalvatronSearchEngine:
                     sequence_parallel=True,
                     sp_space=a.sp_space,
                     chunks=chunks,
+                    comm_quant_block=a.comm_quant_block,
                     # every emitted pp>1 config runs the 1F1B engine
                     # (save_results labels them pipedream_flush below), so the
                     # memory model must price the 1F1B watermark, not gpipe
@@ -328,6 +351,7 @@ class GalvatronSearchEngine:
                     allreduce_dict=self.allreduce_dict,
                     all2all_dict=self.all2all_dict,
                     costmodel_coe=self.args.costmodel_coe,
+                    quant_overhead_coe=getattr(self, "quant_overhead_coe", 0.02),
                 )
             )
         return ma_list, ta_list, pa_list, pma_list, pha_list
@@ -480,6 +504,10 @@ class GalvatronSearchEngine:
             bsz, mbsz=max(1, bsz * min_tp // self.world_size), min_tp=min_tp,
             max_tp=max_tp, vsp=vsp, embed_sdp=embed_sdp, chunks=chunks,
         )
+        if res is not None and self.args.comm_quant != "off":
+            cost, res = self._enforce_comm_quant_contract(
+                cost, res, pp, vtp, vsp, bsz, bundles, tlog,
+            )
         if tlog:
             tlog.info("result: cost=%s vtp=%s pp=%s remaining_mem=%s" % (cost, vtp, pp, rem))
             if res:
@@ -503,6 +531,75 @@ class GalvatronSearchEngine:
                     tlog.info("winner rejected by runtime validator: %s" % e)
                 return dict(result, cost=float("inf"), strategies=None)
         return result
+
+    def _enforce_comm_quant_contract(self, cost, res, pp, vtp, vsp, bsz,
+                                     bundles, tlog=None):
+        """Post-DP guards for the comm-precision axis.
+
+        (a) Runtime-support mirror: quantized layers inside a config the
+        quantized ring cannot run (pp>1, any tp/cp/sp layer, vocab
+        parallelism — the GLS013 contract) are stripped back to 'none' so
+        an emitted config ALWAYS lints clean; (b) the user accuracy budget
+        (``--comm_quant_budget``, max fraction of layers quantized):
+        layers whose modeled time saving is smallest are de-quantized
+        first, the reported cost adjusted by each flip's delta."""
+
+        def quantized(s):
+            info = s[3] if len(s) > 3 else {}
+            return info.get("gcd", "none") != "none" or \
+                info.get("pcd", "none") != "none"
+
+        def strip(s):
+            info = dict(s[3]) if len(s) > 3 else {}
+            info.pop("gcd", None)
+            info.pop("pcd", None)
+            return [s[0], s[1], s[2], info]
+
+        if not any(quantized(s) for s in res):
+            return cost, res
+        mixed = pp > 1 or vtp > 1 or vsp or any(
+            s[1] > 1 or (s[3] if len(s) > 3 else {}).get("cp", 1) > 1
+            or (s[3] if len(s) > 3 else {}).get("sp", 0) for s in res
+        )
+        if mixed:
+            if tlog:
+                tlog.info("comm_quant: winner mixes quantized layers into a "
+                          "non-pure-dp config; stripping (GLS013 contract)")
+            return cost, [strip(s) if quantized(s) else s for s in res]
+        budget = float(self.args.comm_quant_budget)
+        n_quant = sum(1 for s in res if quantized(s))
+        allowed = int(math.floor(budget * len(res) + 1e-9))
+        if n_quant <= allowed:
+            return cost, res
+        ma_list, ta_list, pa_list, pma_list, pha_list = bundles
+        layer_type_ids = []
+        for t, lc in enumerate(self.layer_configs):
+            layer_type_ids += [t] * lc["layer_num"]
+
+        def layer_ms(s, t):
+            return TimeCostModel(
+                s, bsz, model_args=ma_list[t], train_args=ta_list[t],
+                parallel_args=pa_list[t], profile_model_args=pma_list[t],
+                profile_hardware_args=pha_list[t],
+            ).gen_result()
+
+        flips = []  # (saving, layer index, stripped twin, delta)
+        for i, s in enumerate(res):
+            if not quantized(s):
+                continue
+            t = layer_type_ids[i]
+            twin = strip(s)
+            delta = layer_ms(twin, t) - layer_ms(s, t)  # cost of flipping
+            flips.append((delta, i, twin))
+        flips.sort(key=lambda f: f[0])  # cheapest flips (smallest saving) first
+        res = list(res)
+        for delta, i, twin in flips[: n_quant - allowed]:
+            res[i] = twin
+            cost += delta
+        if tlog:
+            tlog.info("comm_quant budget %.2f: de-quantized %d of %d layers"
+                      % (budget, n_quant - allowed, n_quant))
+        return cost, res
 
     def parallelism_optimization(self) -> Optional[dict]:
         """Outer loop over bsz x chunks x vsp x embed_sdp (reference
@@ -581,6 +678,8 @@ class GalvatronSearchEngine:
                     fsdp=info.get("fsdp", 0),
                     checkpoint=info.get("cpt", 0),
                     tp_consec=info.get("tp", 1),
+                    grad_comm_dtype=info.get("gcd", "none"),
+                    param_comm_dtype=info.get("pcd", "none"),
                 )
             )
         return HybridParallelConfig(
@@ -595,6 +694,7 @@ class GalvatronSearchEngine:
             vocab_tp=result["vtp"] if result["vtp"] > 0 else 1,
             vocab_sp=result["vsp"],
             embed_sdp=int(result["embed_sdp"]),
+            comm_quant_block=self.args.comm_quant_block,
         )
 
     def save_results(self, result: dict, path: Optional[str] = None) -> str:

@@ -29,6 +29,12 @@ def form_strategy(strategy):
         tag += "-fsdp"
     if info.get("cpt", info.get("ckpt", 0)):
         tag += "-ckpt"
+    # comm-precision axis (quantized collectives): part of the identity —
+    # the cost-model caches key on this string
+    if info.get("gcd", "none") != "none":
+        tag += "-g%s" % info["gcd"]
+    if info.get("pcd", "none") != "none":
+        tag += "-p%s" % info["pcd"]
     return tag
 
 

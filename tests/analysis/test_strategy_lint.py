@@ -38,6 +38,7 @@ BROKEN = {
     "GLS009": ("broken/gls009_vocab_tp.json", {"model_cfg": MODEL}),
     "GLS010": ("broken/gls010_gpipe_nonuniform.json", {}),
     "GLS011": ("broken/gls011_ckpt_nonuniform.json", {}),
+    "GLS013": ("broken/gls013_quant_unsupported.json", {}),
 }
 WARN = {
     "GLS101": ("warn/gls101_over_budget.json",
@@ -195,3 +196,78 @@ def test_tp_comm_mode_bad_value_is_gls005():
          "dp_types_enc": "0,0,0,0", "global_bsz": 8}, WORLD,
         tp_comm_mode="bogus")
     assert not report.ok and "GLS005" in report.codes(), report.render()
+
+
+# ------------------------------------------- quantized collectives (ISSUE 9)
+def test_comm_quant_inert_param_fixture_warns_gls103():
+    report = lint("warn/gls103_inert_param_comm.json")
+    assert report.ok, report.render()
+    warns = [d for d in report.warnings if d.code == "GLS103"]
+    assert warns and "param_comm_dtype" in warns[0].message, report.render()
+
+
+def test_comm_quant_valid_fixture_is_clean():
+    report = lint("valid/quant_dp8.json")
+    assert report.ok and not report.warnings, report.render()
+
+
+def test_comm_quant_with_tp_is_gls013():
+    report = lint("broken/gls013_quant_unsupported.json")
+    assert not report.ok and "GLS013" in report.codes(), report.render()
+    [d] = [d for d in report.diagnostics if d.code == "GLS013"]
+    assert "pure" in d.message and "data-parallel" in d.message
+
+
+def test_comm_quant_anomaly_guard_is_gls013():
+    """Driver state the strategy cannot see: the guard's bitwise
+    spike/rollback contract refuses the quantized sync — only when the
+    caller (the train driver) passes anomaly_guard."""
+    d = {"pp_deg": 1, "tp_sizes_enc": "1,1,1,1", "dp_types_enc": "0,0,0,0",
+         "grad_comm_dtype": "int8,int8,int8,int8", "global_bsz": 8}
+    from galvatron_tpu.config.strategy import HybridParallelConfig
+
+    hp = HybridParallelConfig.from_json(d, world_size=WORLD)
+    assert S.lint_hp(hp, anomaly_guard=True).codes() == ["GLS013"]
+    assert S.lint_hp(hp, anomaly_guard=False).ok
+    assert S.lint_hp(hp).ok  # file-level lints skip the driver-state check
+
+
+def test_comm_quant_zero2_is_gls013():
+    report = S.lint_strategy_dict(
+        {"pp_deg": 1, "tp_sizes_enc": "1,1,1,1", "dp_types_enc": "0,0,0,0",
+         "grad_comm_dtype": "bf16,bf16,bf16,bf16", "global_bsz": 8,
+         "default_dp_type": "zero2"}, WORLD)
+    assert not report.ok and "GLS013" in report.codes(), report.render()
+
+
+def test_comm_quant_bad_dtype_is_gls005_with_hint():
+    report = S.lint_strategy_dict(
+        {"pp_deg": 1, "tp_sizes_enc": "1,1,1,1", "dp_types_enc": "0,0,0,0",
+         "grad_comm_dtype": "int8,in8,int8,int8", "global_bsz": 8}, WORLD)
+    assert not report.ok and "GLS005" in report.codes(), report.render()
+    [d] = [d for d in report.diagnostics if d.code == "GLS005"]
+    assert d.hint and "int8" in d.hint
+
+
+def test_tp_comm_quant_under_gspmd_is_gls013():
+    # construct-time refusal too: validate() raises the same diagnostic
+    report = S.lint_strategy_dict(
+        {"pp_deg": 1, "tp_sizes_enc": "2,2,2,2", "dp_types_enc": "0,0,0,0",
+         "global_bsz": 8}, WORLD, tp_comm_quant="int8")
+    assert not report.ok and "GLS013" in report.codes(), report.render()
+
+
+def test_tp_comm_quant_with_manual_mode_is_clean():
+    report = S.lint_strategy_dict(
+        {"pp_deg": 1, "tp_sizes_enc": "2,2,2,2", "dp_types_enc": "0,0,0,0",
+         "global_bsz": 8}, WORLD, tp_comm_mode="overlap", tp_comm_quant="int8")
+    assert report.ok and "GLS103" not in report.codes(), report.render()
+
+
+def test_tp_comm_quant_inert_at_tp1_warns_gls103():
+    report = S.lint_strategy_dict(
+        {"pp_deg": 1, "tp_sizes_enc": "1,1,1,1", "dp_types_enc": "0,0,0,0",
+         "global_bsz": 8}, WORLD, tp_comm_mode="overlap", tp_comm_quant="int8")
+    assert report.ok, report.render()
+    msgs = [d.message for d in report.warnings if d.code == "GLS103"]
+    assert any("tp_comm_quant" in m for m in msgs), report.render()

@@ -182,3 +182,44 @@ def test_swin_trains_on_real_npy_shards(devices8, tmp_path):
         "--lr", "1e-3",
     ])
     assert len(s["losses"]) == 2 and np.isfinite(s["losses"]).all()
+
+
+@pytest.mark.usefixtures("disable_persistent_compile_cache")
+def test_train_quantized_grad_sync_driver_telemetry(devices8, tmp_path):
+    """ISSUE 9 driver-level wiring: --grad_comm_dtype int8 (anomaly guard
+    off — the GLS013 composition refusal) trains finite losses through the
+    quantized shard_map ring, emits a schema-valid quant_comm event, and
+    `cli report` joins it into the analysis. (Trajectory-vs-fp32 tolerance
+    is pinned by tests/parallel/test_quant_collectives.py; the slow variant
+    below re-checks it through the driver.)"""
+    from galvatron_tpu.obs import report as R
+    from galvatron_tpu.obs import telemetry as T
+
+    tele = str(tmp_path / "q.jsonl")
+    s = run(["--world_size", "4", "--anomaly_guard", "0",
+             "--grad_comm_dtype", "int8", "--telemetry", tele])
+    assert np.isfinite(s["losses"]).all()
+    events, errors = T.read_events(tele, strict=False)
+    assert not errors, errors
+    qc = [e for e in events if e["type"] == "quant_comm"]
+    assert qc and qc[0]["grad_comm_dtype"] == "int8,int8"
+    assert qc[0].get("wire_mb_configured") is not None
+    analysis = R.analyze(events)
+    assert analysis["quant_comm"], "report must surface the quant_comm event"
+
+
+@pytest.mark.slow
+@pytest.mark.usefixtures("disable_persistent_compile_cache")
+def test_train_quantized_grad_sync_driver_parity(devices8):
+    base = ["--world_size", "8", "--anomaly_guard", "0"]
+    ref = run(base)
+    s = run(base + ["--grad_comm_dtype", "int8"])
+    np.testing.assert_allclose(ref["losses"], s["losses"], rtol=5e-3, atol=5e-4)
+
+
+def test_train_quantized_with_guard_refuses_gls013(devices8):
+    from galvatron_tpu.analysis.diagnostics import DiagnosticError
+
+    with pytest.raises(DiagnosticError, match="GLS013"):
+        run(["--world_size", "8", "--grad_comm_dtype", "int8",
+             "--anomaly_guard", "1"])

@@ -172,3 +172,132 @@ def test_parallel_search_matches_serial():
     assert serial is not None and parallel is not None
     assert np.isclose(serial["cost"], parallel["cost"])
     assert serial["bsz"] == parallel["bsz"]
+
+
+# ------------------------------------------- comm-precision fields (ISSUE 9)
+def test_comm_dtype_fields_round_trip_json():
+    """grad/param comm dtypes are SERIALIZED per-layer strategy fields
+    (unlike the tp_comm_mode runtime knob): save -> from_json -> save is
+    the identity, and provenance built from the config carries them."""
+    from galvatron_tpu.config.strategy import HybridParallelConfig, LayerStrategy
+
+    layers = [
+        LayerStrategy(tp=1, fsdp=1, grad_comm_dtype="int8",
+                      param_comm_dtype="int8"),
+        LayerStrategy(tp=1, fsdp=1, grad_comm_dtype="fp8_e4m3",
+                      param_comm_dtype="none"),
+        LayerStrategy(tp=1, grad_comm_dtype="bf16"),
+        LayerStrategy(tp=1),
+    ]
+    hp = HybridParallelConfig(world_size=8, pp=1, layers=layers,
+                              global_bsz=8, comm_quant_block=32)
+    d = hp.to_json_dict()
+    assert d["grad_comm_dtype"] == "int8,fp8_e4m3,bf16,none"
+    assert d["param_comm_dtype"] == "int8,none,none,none"
+    assert d["comm_quant_block"] == 32
+    hp2 = HybridParallelConfig.from_json(d, world_size=8)
+    assert hp2.to_json_dict() == d
+    assert [s.grad_comm_dtype for s in hp2.layers] == \
+        ["int8", "fp8_e4m3", "bf16", "none"]
+    hp2.assert_equal(hp)
+
+    # elastic provenance round-trip: the strategy block IS the json dict,
+    # so a resume on the same world restores the comm-precision axis
+    import types
+
+    from galvatron_tpu.runtime.elastic import build_provenance
+
+    prov = build_provenance(hp, model_cfg=types.SimpleNamespace(hidden_size=8))
+    hp3 = HybridParallelConfig.from_json(dict(prov["strategy"]), world_size=8)
+    assert [s.grad_comm_dtype for s in hp3.layers] == \
+        [s.grad_comm_dtype for s in hp.layers]
+    assert hp3.comm_quant_block == 32
+
+
+def test_comm_dtype_defaults_absent_keys():
+    """Pre-ISSUE-9 strategy JSONs (no comm keys) load with 'none'
+    everywhere — old checkpoints' provenance stays resumable."""
+    from galvatron_tpu.config.strategy import HybridParallelConfig
+
+    hp = HybridParallelConfig.from_json(
+        {"pp_deg": 1, "tp_sizes_enc": "1,1", "dp_types_enc": "0,0",
+         "global_bsz": 8}, world_size=8)
+    assert all(s.grad_comm_dtype == "none" for s in hp.layers)
+    assert all(s.param_comm_dtype == "none" for s in hp.layers)
+    assert hp.comm_quant_block == 64
+
+
+def test_comm_dtype_unknown_key_strictness_gls001():
+    """GLS001 strictness still rejects typos of the NEW keys."""
+    from galvatron_tpu.analysis.diagnostics import DiagnosticError
+    from galvatron_tpu.config.strategy import HybridParallelConfig
+
+    with pytest.raises(DiagnosticError, match="GLS001"):
+        HybridParallelConfig.from_json(
+            {"pp_deg": 1, "tp_sizes_enc": "1,1", "dp_types_enc": "0,0",
+             "grad_com_dtype": "int8,int8", "global_bsz": 8}, world_size=8)
+
+
+def test_comm_dtype_bad_enum_and_length_rejected():
+    from galvatron_tpu.analysis.diagnostics import DiagnosticError
+    from galvatron_tpu.config.strategy import HybridParallelConfig
+
+    with pytest.raises(DiagnosticError, match="GLS005"):
+        HybridParallelConfig.from_json(
+            {"pp_deg": 1, "tp_sizes_enc": "1,1", "dp_types_enc": "0,0",
+             "grad_comm_dtype": "int9,int8", "global_bsz": 8}, world_size=8)
+    with pytest.raises(DiagnosticError, match="GLS006"):
+        HybridParallelConfig.from_json(
+            {"pp_deg": 1, "tp_sizes_enc": "1,1", "dp_types_enc": "0,0",
+             "grad_comm_dtype": "int8", "global_bsz": 8}, world_size=8)
+    with pytest.raises(DiagnosticError, match="GLS005"):
+        HybridParallelConfig.from_json(
+            {"pp_deg": 1, "tp_sizes_enc": "1,1", "dp_types_enc": "0,0",
+             "comm_quant_block": 0, "global_bsz": 8}, world_size=8)
+
+
+def test_comm_dtype_does_not_split_layer_runs():
+    """Comm precision changes the grad sync, not the layer program: a
+    per-layer dtype mix still compiles as ONE scanned run."""
+    from galvatron_tpu.config.strategy import (
+        HybridParallelConfig,
+        LayerStrategy,
+        layer_runs,
+    )
+
+    hp = HybridParallelConfig(
+        world_size=8, pp=1,
+        layers=[LayerStrategy(grad_comm_dtype="int8"),
+                LayerStrategy(grad_comm_dtype="none"),
+                LayerStrategy(grad_comm_dtype="fp8_e4m3"),
+                LayerStrategy()],
+        global_bsz=8)
+    assert len(layer_runs(hp)) == 1
+
+
+def test_comm_dtype_survives_migration_resolution(tmp_path):
+    """Acceptance criterion: a quantized strategy JSON resolves as a live-
+    migration target with no GLS refusal, comm-precision fields intact
+    (the relayout itself is agnostic — the fields only steer the rebuilt
+    train step)."""
+    import argparse
+    import json
+
+    from galvatron_tpu.config.strategy import HybridParallelConfig
+    from galvatron_tpu.models.base import TransformerConfig
+    from galvatron_tpu.runtime.elastic import resolve_migration_strategy
+
+    cfg = TransformerConfig(hidden_size=64, num_heads=4, num_layers=2,
+                            vocab_size=128, max_seq_len=32)
+    current = HybridParallelConfig.uniform(8, 2, tp=2, global_bsz=8)
+    target = HybridParallelConfig.uniform(
+        8, 2, tp=1, global_bsz=8, grad_comm_dtype="int8",
+        param_comm_dtype="int8", sdp=1)
+    path = tmp_path / "target.json"
+    path.write_text(json.dumps(target.to_json_dict()))
+    args = argparse.Namespace(elastic_strategy=str(path),
+                              elastic_memory_gb=1024.0)
+    hp, action = resolve_migration_strategy(args, cfg, 8, current)
+    assert action == "strategy_file"
+    assert all(s.grad_comm_dtype == "int8" for s in hp.layers)
+    assert all(s.param_comm_dtype == "int8" for s in hp.layers)

@@ -379,3 +379,83 @@ def test_no_sequence_sharding_filters_sp_at_any_pp():
     assert blocked is None or not any(
         s[3].get("sp") for s in blocked["strategies"] if len(s) > 3
     )
+
+
+# ------------------------------------------- comm-precision axis (ISSUE 9)
+def _quant_engine(bw_gbps, quant_coe, budget=1.0, comm_quant="int8"):
+    allreduce = {"allreduce_size_%d_consec_1" % d: bw_gbps for d in (2, 4, 8)}
+    args = SearchArgs(memory_constraint=16.0, settle_bsz=16, settle_chunk=2,
+                      search_space="dp", disable_pp=True, disable_tp=True,
+                      disable_vtp=True, comm_quant=comm_quant,
+                      comm_quant_budget=budget)
+    eng = GalvatronSearchEngine(
+        args, 8, [{"hidden_size": 4096, "seq_len": 2048, "layer_num": 8}],
+        model_name="mock")
+    eng.set_model_profiles(TIME_CONFIG, MEMORY_CONFIG)
+    eng.set_hardware_profiles(
+        allreduce, None,
+        {"overlap_coe": 1.12, "quant_overhead_coe": quant_coe})
+    eng.initialize_search_engine()
+    return eng
+
+
+def _gcds(best):
+    return [(s[3] if len(s) > 3 else {}).get("gcd", "none")
+            for s in best["strategies"]]
+
+
+def test_search_picks_int8_when_bandwidth_dominated():
+    """Slow interconnect (2 GB/s) + cheap quantization: the grad-sync bytes
+    dominate the step, so every layer flips to the int8 wire."""
+    best = _quant_engine(2.0, 0.001).parallelism_optimization()
+    assert best is not None
+    assert all(g == "int8" for g in _gcds(best)), _gcds(best)
+
+
+def test_search_keeps_fp32_when_compute_dominated():
+    """Fast interconnect + an expensive quantize/dequantize toll: the sync
+    is already cheap, so quantization only adds overhead and loses."""
+    best = _quant_engine(500.0, 5.0).parallelism_optimization()
+    assert best is not None
+    assert all(g == "none" for g in _gcds(best)), _gcds(best)
+
+
+def test_search_accuracy_budget_caps_quantized_fraction():
+    best = _quant_engine(2.0, 0.001, budget=0.5).parallelism_optimization()
+    assert best is not None
+    assert sum(1 for g in _gcds(best) if g == "int8") == 4, _gcds(best)
+
+
+def test_quantized_winner_round_trips_save_lint_load(tmp_path):
+    """Acceptance criterion: the emitted strategy JSON carries per-layer
+    comm-precision fields and survives save_results' lint gate, a reload,
+    and a fresh lint with no GLS refusals."""
+    from galvatron_tpu.analysis import strategy_lint as slint
+
+    eng = _quant_engine(2.0, 0.001)
+    best = eng.parallelism_optimization()
+    path = eng.save_results(best, str(tmp_path / "quant.json"))
+    cfg = HybridParallelConfig.from_json(path, world_size=8)
+    assert all(s.grad_comm_dtype == "int8" for s in cfg.layers)
+    report = slint.lint_strategy_file(path, 8)
+    assert report.ok, report.render()
+    # zero3 layers in the space also carry the quantized param gather
+    import json
+
+    with open(path) as f:
+        d = json.load(f)
+    assert "grad_comm_dtype" in d and "comm_quant_block" in d
+
+
+def test_comm_quant_off_leaves_space_unchanged():
+    s_off = generate_strategies(8, SearchArgs())
+    assert not any(
+        (s[3] if len(s) > 3 else {}).get("gcd") for s in s_off)
+    s_on = generate_strategies(8, SearchArgs(comm_quant="int8"))
+    quant = [s for s in s_on if (s[3] if len(s) > 3 else {}).get("gcd")]
+    assert quant
+    # variants exist only where the quantized ring can run (pure dp, dp>1)
+    assert all(s[0] == 1 and s[1] == 1 and s[2] > 1
+               and not s[3].get("sp") for s in quant)
+    # zero3 variants carry the quantized param gather too
+    assert any(s[3].get("fsdp") and s[3].get("pcd") == "int8" for s in quant)

@@ -70,17 +70,23 @@ def test_mfu_regression_gate_exit_codes(tmp_path):
         "value": 5.0, "extra": {
             "train_step": {"mfu": 0.4, "tokens_per_sec_per_chip": 30000.0},
             "tp_overlap": {"gspmd": {"step_ms": 10.0},
-                           "overlap": {"step_ms": 9.0}}}}}
+                           "overlap": {"step_ms": 9.0}},
+            "quant_comm": {"fp32": {"step_ms": 20.0},
+                           "int8": {"step_ms": 22.0},
+                           "loss_delta_int8": 5e-05}}}}
     empty_round = {"n": 4, "parsed": None}  # wedged round: tolerated, skipped
     (tmp_path / "BENCH_r03.json").write_text(json.dumps(baseline))
     (tmp_path / "BENCH_r04.json").write_text(json.dumps(empty_round))
 
-    def run_gate(mfu, gate="1", overlap_step_ms=9.0):
+    def run_gate(mfu, gate="1", overlap_step_ms=9.0, quant_step_ms=22.0):
         fake = tmp_path / "fake.json"
         fake.write_text(json.dumps({"results": {
             "train_step": {"mfu": mfu, "tokens_per_sec_per_chip": 30000.0},
             "tp_overlap": {"gspmd": {"step_ms": 10.0},
-                           "overlap": {"step_ms": overlap_step_ms}}}}))
+                           "overlap": {"step_ms": overlap_step_ms}},
+            "quant_comm": {"fp32": {"step_ms": 20.0},
+                           "int8": {"step_ms": quant_step_ms},
+                           "loss_delta_int8": 5e-05}}}))
         env = dict(os.environ,
                    GALVATRON_BENCH_FAKE_RESULTS=str(fake),
                    GALVATRON_BENCH_GATE=gate,
@@ -98,6 +104,11 @@ def test_mfu_regression_gate_exit_codes(tmp_path):
     p = run_gate(0.4, overlap_step_ms=15.0)
     assert p.returncode == 1, p.stdout
     assert "tp_overlap.overlap.step_ms" in p.stdout
+    # the quantized grad-sync path is gated too (ISSUE 9): a slower int8
+    # step regresses even with every other number healthy
+    p = run_gate(0.4, quant_step_ms=30.0)
+    assert p.returncode == 1, p.stdout
+    assert "quant_comm.int8.step_ms" in p.stdout
     p = run_gate(0.2, gate="")  # gate off: wedge-proofing contract holds
     assert p.returncode == 0 and "MFU-REGRESSION" not in p.stdout
     # no usable baseline at all: tolerated
