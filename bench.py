@@ -45,6 +45,7 @@ import time
 
 from _bench_util import (
     apply_jax_platforms_override,
+    concurrent_bench_processes,
     interpret_ctx_factory,
     kill_group,
     load_latest_baseline,
@@ -702,6 +703,82 @@ def section_quant_comm():
     return out
 
 
+def section_serve():
+    """Searched-strategy serving (ISSUE 11): the shipped cli/serve driver on
+    the multi-virtual-device CPU config — the gspmd baseline layout (tp=1:
+    weights replicated per chip, decode slots sharded over dp) vs the
+    serve-objective winner shape for this geometry (tp=2: weight and KV
+    reads split across chips, the layout `search --objective serve` picks
+    once decode is weight-read-bound). Each mode runs the synthetic load
+    twice in-process: the first (cold) pass pays trace+compile for every
+    bucket executable, the second rides the in-process AOT memo and is the
+    steady-state measurement — tokens/s(/chip), TTFT/TPOT percentiles, and
+    the median decode step from the decode_batch telemetry stream. CPU
+    numbers are host noise in absolute terms; the regression gate pins them
+    so the serving path cannot silently decay and the first real-silicon
+    round has a baseline shape to fill in."""
+    import statistics
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from galvatron_tpu.cli.arguments import initialize_galvatron
+    from galvatron_tpu.cli.serve import serve
+
+    n_req = 4 if SMOKE else 8
+    n_new = 4 if SMOKE else 8
+    out = {"world": 4, "requests": n_req, "max_new_tokens": n_new,
+           "max_concurrency": 4}
+    tdir = tempfile.mkdtemp(prefix="galv_bench_serve_")
+    tps = {}
+    for name, tp in (("gspmd", 1), ("searched", 2)):
+        tele = os.path.join(tdir, name + ".jsonl")
+        argv = [
+            "--model_type", "gpt", "--set_model_config_manually", "1",
+            "--hidden_size", "64", "--num_attention_heads", "4",
+            "--num_layers", "2", "--vocab_size", "256", "--seq_length", "128",
+            "--mixed_precision", "fp32", "--global_train_batch_size", "8",
+            "--world_size", "4", "--global_tp_deg", str(tp),
+            "--serve_max_concurrency", "4", "--serve_page_size", "16",
+            "--num_requests", str(n_req), "--rate_rps", "0",
+            "--prompt_len_min", "4", "--prompt_len_max", "12",
+            "--max_new_tokens", str(n_new),
+        ]
+        t0 = time.perf_counter()
+        serve(initialize_galvatron(mode="serve", argv=argv))
+        cold_ms = (time.perf_counter() - t0) * 1e3
+        # telemetry only on the warm pass: the cold pass's per-bucket compile
+        # ticks would pollute the decode step_ms median
+        t0 = time.perf_counter()
+        s = serve(initialize_galvatron(
+            mode="serve", argv=argv + ["--telemetry", tele]))
+        warm_ms = (time.perf_counter() - t0) * 1e3
+        steps = []
+        with open(tele) as f:
+            for line in f:
+                ev = json.loads(line)
+                if ev.get("type") == "decode_batch" and ev.get("step_ms") is not None:
+                    steps.append(float(ev["step_ms"]))
+        tps[name] = s["tokens_per_s"]
+        out[name] = {
+            "tokens_per_s": round(s["tokens_per_s"], 2),
+            "tokens_per_s_per_chip": round(s["tokens_per_s_per_chip"], 3),
+            "ttft_ms_p50": round(s["ttft_ms"]["p50"], 2),
+            "ttft_ms_p99": round(s["ttft_ms"]["p99"], 2),
+            "tpot_ms_p50": round(s["tpot_ms"]["p50"], 2),
+            "tpot_ms_p99": round(s["tpot_ms"]["p99"], 2),
+            "decode_step_ms": round(statistics.median(steps), 3) if steps else None,
+            "decode_steps": s.get("decode_steps"),
+            "build_plus_load_ms": round(cold_ms, 1),
+            "warm_load_ms": round(warm_ms, 1),
+        }
+    if tps["gspmd"] > 0:
+        out["searched_vs_gspmd"] = round(tps["searched"] / tps["gspmd"], 3)
+    return out
+
+
 SECTIONS = {
     "layer_fwd": section_layer_fwd,
     "train_step": section_train_step,
@@ -710,6 +787,7 @@ SECTIONS = {
     "train_loop": section_train_loop,
     "tp_overlap": section_tp_overlap,
     "quant_comm": section_quant_comm,
+    "serve": section_serve,
 }
 
 
@@ -725,7 +803,7 @@ DEADLINE_S = float(os.environ.get("GALVATRON_BENCH_DEADLINE", "200" if SMOKE els
 # (~20-40s each), so it gets headroom; the deadline still caps the total
 SECTION_BUDGETS = {"layer_fwd": 300.0, "train_step": 360.0, "breakdown": 200.0,
                    "masked_flash": 180.0, "train_loop": 200.0,
-                   "tp_overlap": 200.0, "quant_comm": 200.0}
+                   "tp_overlap": 200.0, "quant_comm": 200.0, "serve": 200.0}
 _START = time.time()
 _ACTIVE_CHILD = None  # Popen of the in-flight section, for watchdog cleanup
 
@@ -784,6 +862,7 @@ def _run_section(name, errors, extra_env=None, reserve_s=0.0):
 
 def main():
     results, errors = {}, {}
+    timing_hazards = []
 
     def emit_and_exit(signum=None, frame=None):
         layer = results.get("layer_fwd") or {}
@@ -804,6 +883,10 @@ def main():
             extra["tp_overlap"] = results["tp_overlap"]
         if results.get("quant_comm"):
             extra["quant_comm"] = results["quant_comm"]
+        if results.get("serve"):
+            extra["serve"] = results["serve"]
+        if timing_hazards:
+            extra["timing_hazard"] = timing_hazards
         if errors:
             extra["errors"] = errors
         _kill_active_child()  # don't leave a wedged child squatting the chip
@@ -862,6 +945,15 @@ def main():
         errors.update(canned.get("errors", {}))
         emit_and_exit()
 
+    # timing discipline: a concurrent bench (another round, a stray wedged
+    # child) on the same host corrupts every number — record what
+    # `pgrep -af bench` saw BEFORE any section times, so a suspect round is
+    # visibly suspect in its own payload instead of silently noisy
+    timing_hazards.extend(concurrent_bench_processes())
+    for line in timing_hazards:
+        print("TIMING-HAZARD: concurrent bench-like process: %s" % line,
+              file=sys.stderr)
+
     # last-resort watchdog: even if the orchestrator itself stalls (e.g. in
     # communicate() on a wedged child), the JSON line with whatever was
     # measured still goes out, and the child is killed so it can't keep
@@ -895,6 +987,12 @@ def main():
         }, reserve_s=floor)
     results["quant_comm"] = _run_section(
         "quant_comm", errors, extra_env={
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "")
+                          + " --xla_force_host_platform_device_count=4").strip(),
+        }, reserve_s=floor)
+    results["serve"] = _run_section(
+        "serve", errors, extra_env={
             "JAX_PLATFORMS": "cpu",
             "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "")
                           + " --xla_force_host_platform_device_count=4").strip(),

@@ -44,6 +44,7 @@ CODES: Dict[str, Tuple[str, str]] = {
     "GLS011": (ERROR, "illegal activation-checkpoint placement"),
     "GLS012": (ERROR, "config unsupported by the manual shard_map TP path"),
     "GLS013": (ERROR, "unsupported comm-precision (quantized collectives) configuration"),
+    "GLS014": (ERROR, "serve-infeasible configuration (latency bound, KV budget, or layout)"),
     # ---- strategy linter (GLS1xx cost-model-backed warnings) ----
     "GLS101": (WARNING, "estimated per-device memory exceeds the HBM budget"),
     "GLS102": (WARNING, "expensive cross-layer redistribution between adjacent layers"),

@@ -385,6 +385,91 @@ def _warning_diagnostics(
     return out
 
 
+def serve_kv_mb_per_device(
+    hp: HybridParallelConfig,
+    model_cfg: Any,
+    max_concurrency: int,
+    page_size: int,
+    dtype_bytes: int = 2,
+) -> Optional[float]:
+    """Per-device MB the decode KV cache pins: `max_concurrency` slots, each
+    holding a full-context (k, v) pair per layer, sharded the way
+    serve/kv_cache.layer_kv_spec shards it (slots over dp, kv heads over tp
+    when divisible). The serve search and the GLS014 budget check price KV
+    through this one function so they agree on what fits."""
+    nh = getattr(model_cfg, "num_heads", None)
+    hd = getattr(model_cfg, "head_dim", None)
+    seq = getattr(model_cfg, "max_seq_len", None)
+    if nh is None or seq is None:
+        return None
+    nkv = getattr(model_cfg, "num_kv_heads", None) or nh
+    hd = hd or getattr(model_cfg, "hidden_size") // nh
+    page = max(int(page_size), 1)
+    max_ctx = -(-seq // page) * page  # bucket-quantised full context
+    total = 0.0
+    for i, s in enumerate(hp.layers):
+        slots_per_dev = max_concurrency / max(hp.dp(i), 1)
+        heads_per_dev = nkv / s.tp if (s.tp > 1 and nkv % s.tp == 0) else nkv
+        total += 2.0 * slots_per_dev * max_ctx * heads_per_dev * hd * dtype_bytes
+    return total / 2**20
+
+
+def _serve_diagnostics(
+    hp: HybridParallelConfig,
+    model_cfg: Any,
+    memory_budget_gb: Optional[float],
+) -> List[D.Diagnostic]:
+    """GLS014: layouts and budgets a decode engine cannot realise
+    (serve/kv_cache.py raises the same refusals at construction; the lint
+    fires them pre-trace with the layer named). Latency-bound infeasibility
+    is the search engine's half of GLS014 — it needs the time cost models."""
+    out: List[D.Diagnostic] = []
+    if hp.pp > 1:
+        out.append(D.make(
+            "GLS014", "pp=%d: the decode engine drives single-token steps "
+            "over one stage; pipeline parallelism is unsupported in serve "
+            "mode" % hp.pp, key="pp_deg",
+        ))
+    for i, s in enumerate(hp.layers):
+        if s.cp > 1:
+            out.append(D.make(
+                "GLS014", "layer %d: cp=%d — ring context parallelism never "
+                "materialises the full per-layer k/v, so a decode cache "
+                "cannot be filled; serve layouts require cp=1" % (i, s.cp),
+                layer=i,
+            ))
+            break
+    for i, s in enumerate(hp.layers):
+        if s.sp:
+            out.append(D.make(
+                "GLS014", "layer %d: use_sp=1 (Ulysses) repurposes the tp "
+                "axes for sequence all-to-alls a length-1 decode query "
+                "cannot use; serve layouts require sp=0" % i, layer=i,
+            ))
+            break
+    conc = hp.serve_max_concurrency
+    if conc > 0 and model_cfg is not None and memory_budget_gb:
+        kv_mb = serve_kv_mb_per_device(
+            hp, model_cfg, conc, hp.serve_page_size or 16)
+        layer_mb = _analytic_parameter_mb(model_cfg)
+        if kv_mb is not None and layer_mb is not None:
+            # bf16 inference weights, sharded over tp (and dp when fsdp)
+            param_mb = sum(
+                layer_mb / 2.0 / s.tp / (hp.dp(i) if s.fsdp else 1)
+                for i, s in enumerate(hp.layers)
+            )
+            budget_mb = memory_budget_gb * 1024.0
+            if kv_mb + param_mb > budget_mb:
+                out.append(D.make(
+                    "GLS014", "KV cache for %d concurrent slots needs %.1f MB"
+                    "/device on top of %.1f MB of weights — over the %.1f GB "
+                    "budget; lower concurrency, context, or raise tp/dp"
+                    % (conc, kv_mb, param_mb, memory_budget_gb),
+                    key="serve_max_concurrency",
+                ))
+    return out
+
+
 # ------------------------------------------------------------- entry points
 
 
@@ -395,13 +480,18 @@ def lint_hp(
     memory_profile: Optional[dict] = None,
     file: Optional[str] = None,
     anomaly_guard: Optional[bool] = None,
+    mode: Optional[str] = None,
 ) -> D.DiagnosticReport:
     """Lint an already-constructed config (the train-driver / search-engine
     hook): engine-consistency + model-aware checks + cost warnings. The
     construction itself already enforced schema + structure.
     ``anomaly_guard`` is driver state (not part of the strategy): the train
     driver passes it so the quantized-comm x guard refusal (GLS013) fires
-    pre-trace; file-level lints leave it None and skip that check."""
+    pre-trace; file-level lints leave it None and skip that check.
+    ``mode`` is likewise driver state: "serve" turns on the GLS014
+    serve-feasibility layer (cli/serve and the serve-objective search),
+    "train" warns GLS103 on inert serve knobs; None (file-level lint
+    without --serve) runs neither."""
     report = D.DiagnosticReport()
     report.extend(hp.structural_diagnostics())
     report.extend(hp.pipeline_engine_diagnostics())
@@ -410,6 +500,14 @@ def lint_hp(
     report.extend(_tp_comm_mode_diagnostics(hp, model_cfg))
     report.extend(_comm_quant_diagnostics(hp, model_cfg, anomaly_guard))
     report.extend(_warning_diagnostics(hp, model_cfg, memory_budget_gb, memory_profile))
+    if mode == "serve":
+        report.extend(_serve_diagnostics(hp, model_cfg, memory_budget_gb))
+    elif mode == "train" and (hp.serve_max_concurrency or hp.serve_page_size):
+        report.add(D.make(
+            "GLS103", "serve_max_concurrency/serve_page_size are inert in "
+            "train mode: only the serve engine allocates a KV cache",
+            key="serve_max_concurrency",
+        ))
     if file:
         report.diagnostics = [
             D.Diagnostic(**{**d.__dict__, "file": d.file or file})
@@ -425,6 +523,7 @@ def lint_strategy_dict(
     memory_budget_gb: Optional[float] = None,
     memory_profile: Optional[dict] = None,
     file: Optional[str] = None,
+    mode: Optional[str] = None,
     **overrides,
 ) -> D.DiagnosticReport:
     """Lint a raw strategy dict (the on-disk JSON schema) bottom-up. Stops
@@ -444,7 +543,7 @@ def lint_strategy_dict(
         return _with_file(report, file)
     report.extend(lint_hp(
         hp, model_cfg=model_cfg, memory_budget_gb=memory_budget_gb,
-        memory_profile=memory_profile,
+        memory_profile=memory_profile, mode=mode,
     ).diagnostics)
     return _with_file(report, file)
 
@@ -455,12 +554,13 @@ def lint_strategy_file(
     model_cfg: Any = None,
     memory_budget_gb: Optional[float] = None,
     memory_profile: Optional[dict] = None,
+    mode: Optional[str] = None,
     **overrides,
 ) -> D.DiagnosticReport:
     return lint_strategy_dict(
         read_json_config(path), world_size, model_cfg=model_cfg,
         memory_budget_gb=memory_budget_gb, memory_profile=memory_profile,
-        file=path, **overrides,
+        file=path, mode=mode, **overrides,
     )
 
 

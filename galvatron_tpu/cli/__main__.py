@@ -4,7 +4,13 @@ Subcommands replace the reference's per-model shell scripts
 (models/*/scripts/train_dist.sh etc.):
 
     train              run training (GLOBAL flags or --galvatron_config_path)
-    search             run the strategy search (CPU only)
+    search             run the strategy search (CPU only; --objective serve
+                       adds the latency-aware serving objective)
+    serve              run the prefill/decode inference engine under a
+                       (searched) strategy: restores a train-layout
+                       checkpoint into the serve layout, drives a synthetic
+                       or replayed load through the continuous batcher,
+                       reports TTFT/TPOT percentiles and tokens/s
     profile            profile model computation/memory
     profile-hardware   profile ICI/DCN collective bandwidths
     lint               static analysis: validate strategy JSONs / scan code
@@ -29,6 +35,8 @@ def main():
         from galvatron_tpu.cli.train import main as run
     elif cmd == "search":
         from galvatron_tpu.cli.search import main as run
+    elif cmd == "serve":
+        from galvatron_tpu.cli.serve import main as run
     elif cmd == "profile":
         from galvatron_tpu.cli.profile import main_model as run
     elif cmd == "profile-hardware":

@@ -15,7 +15,7 @@ import argparse
 import dataclasses
 from typing import Callable, Optional, Sequence
 
-MODES = ("train", "train_dist", "search", "profile", "profile_hardware")
+MODES = ("train", "train_dist", "search", "profile", "profile_hardware", "serve")
 
 
 def _add_model_args(p: argparse.ArgumentParser):
@@ -344,6 +344,73 @@ def _add_search_args(p: argparse.ArgumentParser):
                         "quantized gradient sync (1.0 = all; 0.0 "
                         "effectively disables). Layers with the smallest "
                         "modeled time saving are de-quantized first")
+    # latency-aware serving objective (ROADMAP item 4)
+    g.add_argument("--objective", type=str, default="train",
+                   choices=("train", "serve"),
+                   help="'train' maximises training throughput (classic DP "
+                        "search); 'serve' prices prefill (compute-bound) and "
+                        "decode (bandwidth-bound) separately and maximises "
+                        "decode tokens/s/chip under the p99 latency bounds, "
+                        "emitting a config that carries serve_max_concurrency"
+                        "/serve_page_size; an unsatisfiable bound refuses "
+                        "with GLS014 instead of emitting a config that "
+                        "misses it")
+    g.add_argument("--p99_ttft_ms", type=float, default=0.0,
+                   help="serve objective: p99 time-to-first-token bound, ms "
+                        "(0 = unbounded)")
+    g.add_argument("--p99_tpot_ms", type=float, default=0.0,
+                   help="serve objective: p99 time-per-output-token bound, "
+                        "ms (0 = unbounded)")
+    g.add_argument("--serve_max_concurrency", type=int, default=8,
+                   help="serve objective: decode slots the engine must hold "
+                        "KV for (sizes both the KV memory term and the "
+                        "decode batch the throughput objective prices)")
+    g.add_argument("--serve_page_size", type=int, default=16,
+                   help="serve objective: KV page granularity; contexts "
+                        "round up to whole pages")
+    g.add_argument("--serve_hbm_gbps", type=float, default=100.0,
+                   help="per-chip HBM read bandwidth backing the decode "
+                        "bandwidth roofline")
+
+
+def _add_serve_args(p: argparse.ArgumentParser):
+    g = p.add_argument_group("serving")
+    g.add_argument("--load", type=str, default=None,
+                   help="checkpoint dir to restore params from (train-layout "
+                        "checkpoints relayout into the serve strategy via "
+                        "the strategy-portable restore path; omitted => "
+                        "fresh random init, for smoke runs)")
+    g.add_argument("--load_iteration", type=int, default=None)
+    g.add_argument("--serve_max_concurrency", type=int, default=None,
+                   help="decode slots (defaults to the strategy JSON's "
+                        "serve_max_concurrency, else 8)")
+    g.add_argument("--serve_page_size", type=int, default=None,
+                   help="KV page granularity (defaults to the strategy "
+                        "JSON's serve_page_size, else 16)")
+    g.add_argument("--serve_max_pages", type=int, default=None,
+                   help="pages per slot (default: enough for the model's "
+                        "max_seq_len)")
+    g.add_argument("--num_requests", type=int, default=16,
+                   help="synthetic requests to run (ignored with --replay)")
+    g.add_argument("--rate_rps", type=float, default=0.0,
+                   help="Poisson arrival rate for the synthetic load "
+                        "(0 = all requests queued at t=0)")
+    g.add_argument("--prompt_len_min", type=int, default=4)
+    g.add_argument("--prompt_len_max", type=int, default=16)
+    g.add_argument("--max_new_tokens", type=int, default=8,
+                   help="output tokens per synthetic request")
+    g.add_argument("--replay", type=str, default=None,
+                   help="JSONL trace ({arrival_s, prompt_len, "
+                        "max_new_tokens} per line) replayed instead of the "
+                        "Poisson load")
+    g.add_argument("--temperature", type=float, default=0.0,
+                   help="0 = greedy argmax; >0 samples from the tempered "
+                        "softmax")
+    g.add_argument("--seed", type=int, default=1234)
+    g.add_argument("--telemetry", type=str, default=None,
+                   help="write serve_request/decode_batch events to this "
+                        "JSONL (analyze with `cli report`)")
+    g.add_argument("--telemetry_buffer", type=int, default=1024)
 
 
 def build_parser(mode: str, extra_args_provider: Optional[Callable] = None) -> argparse.ArgumentParser:
@@ -374,6 +441,10 @@ def build_parser(mode: str, extra_args_provider: Optional[Callable] = None) -> a
                        default="computation", choices=("computation", "memory"))
     elif mode == "profile_hardware":
         _add_hardware_args(p)
+    elif mode == "serve":
+        _add_parallel_args(p)
+        _add_compile_args(p)
+        _add_serve_args(p)
     if extra_args_provider is not None:
         extra_args_provider(p)
     return p
@@ -387,7 +458,7 @@ def initialize_galvatron(extra_args_provider: Optional[Callable] = None,
     core/arguments.py:8-30)."""
     args = build_parser(mode, extra_args_provider).parse_args(argv)
     args.galvatron_mode = mode
-    if mode in ("train", "train_dist", "profile_hardware"):
+    if mode in ("train", "train_dist", "profile_hardware", "serve"):
         # multi-host bootstrap before any jax.devices() call (the reference's
         # torch.distributed env:// init point, core/arguments.py:8-30)
         from galvatron_tpu.runtime.distributed import initialize_distributed

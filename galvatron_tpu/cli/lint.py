@@ -60,6 +60,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--memory_profile", type=str, default=None,
                    help="profiled memory JSON (profiler schema) to back the "
                         "GLS101 estimate instead of the analytic tables")
+    p.add_argument("--serve", action="store_true",
+                   help="lint strategy JSONs for serve-mode feasibility "
+                        "(GLS014: decode-incompatible layouts, KV-cache "
+                        "budget when --memory_budget_gb is given)")
     p.add_argument("--rules", type=str, default=None,
                    help="comma-separated code-lint rule subset, e.g. GLC001")
     return p
@@ -113,6 +117,7 @@ def run(argv: Optional[List[str]] = None) -> int:
                     path, args.world_size, model_cfg=model_cfg,
                     memory_budget_gb=args.memory_budget_gb,
                     memory_profile=memory_profile,
+                    mode="serve" if args.serve else None,
                 ).diagnostics)
             except (OSError, ValueError) as e:
                 print("cannot lint %s: %s" % (path, e), file=sys.stderr)

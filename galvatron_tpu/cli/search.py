@@ -43,6 +43,12 @@ def search_args_from(args) -> SearchArgs:
         comm_quant=getattr(args, "comm_quant", "off"),
         comm_quant_block=getattr(args, "comm_quant_block", 64),
         comm_quant_budget=getattr(args, "comm_quant_budget", 1.0),
+        objective=getattr(args, "objective", "train"),
+        p99_ttft_ms=getattr(args, "p99_ttft_ms", 0.0),
+        p99_tpot_ms=getattr(args, "p99_tpot_ms", 0.0),
+        serve_max_concurrency=getattr(args, "serve_max_concurrency", 8),
+        serve_page_size=getattr(args, "serve_page_size", 16),
+        serve_hbm_gbps=getattr(args, "serve_hbm_gbps", 100.0),
     )
 
 
@@ -85,8 +91,16 @@ def search(args, world_size: Optional[int] = None) -> dict:
             {"hidden_size": cfg.hidden_size, "seq_len": cfg.max_seq_len,
              "layer_num": cfg.num_layers}
         ]
+    sargs = search_args_from(args)
+    if sargs.objective == "serve":
+        # GQA shrinks KV bytes by num_kv_heads/num_heads; the search engine
+        # itself never sees head counts, so resolve the ratio here
+        nkv = getattr(cfg, "num_kv_heads", None)
+        nh = getattr(cfg, "num_heads", None)
+        if nkv and nh:
+            sargs.serve_kv_frac = float(nkv) / float(nh)
     engine = GalvatronSearchEngine(
-        search_args_from(args),
+        sargs,
         world_size,
         model_layer_configs=layer_cfgs,
         config_dir=args.config_dir,
@@ -106,9 +120,19 @@ def search(args, world_size: Optional[int] = None) -> dict:
         read_json_config(hw["sp"]) if os.path.exists(hw["sp"]) else None,
     )
     engine.initialize_search_engine()
-    result = engine.parallelism_optimization()
-    if result is None:
-        raise RuntimeError("no feasible strategy under memory constraint %.1f GB" % args.memory_constraint)
+    if sargs.objective == "serve":
+        # raises DiagnosticError [GLS014] when no candidate satisfies the
+        # memory budget and p99 latency bounds
+        result = engine.serve_optimization()
+        sv = result["serve"]
+        print("serve winner: %.1f tok/s/chip, prefill %.1f ms, decode %.2f ms"
+              "/token, %.0f MB/device (concurrency=%d, ctx=%d)"
+              % (sv["tokens_per_s_per_chip"], sv["prefill_ms"], sv["tpot_ms"],
+                 sv["memory_mb"], sv["concurrency"], sv["max_ctx"]))
+    else:
+        result = engine.parallelism_optimization()
+        if result is None:
+            raise RuntimeError("no feasible strategy under memory constraint %.1f GB" % args.memory_constraint)
     path = engine.save_results(result, args.output_config_path)
     print("saved searched strategy to %s" % path)
     return result

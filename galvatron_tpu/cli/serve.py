@@ -1,0 +1,152 @@
+"""``python -m galvatron_tpu.cli serve`` — searched-strategy inference.
+
+Restores a checkpoint (train layout or serve layout — the strategy-portable
+restore path relayouts either into THIS run's strategy), builds the
+prefill/decode engine over the strategy-sharded KV cache (serve/), drives a
+synthetic or replayed request load through the continuous batcher, and
+reports TTFT/TPOT percentiles and tokens/s.
+
+    python -m galvatron_tpu.cli serve \
+        --galvatron_config_path configs/galvatron_config_serve.json \
+        --load /ckpts/run42 --num_requests 64 --rate_rps 4
+
+The strategy is linted in serve mode before any tracing: pp>1, ring-cp and
+ulysses layouts refuse with GLS014 (the decode step cannot run them), and
+with a --memory_budget the KV+weight budget is checked against the config's
+serve_max_concurrency.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax
+
+from galvatron_tpu.cli.arguments import (
+    hp_config_from_args,
+    initialize_galvatron,
+    model_config_from_args,
+)
+from galvatron_tpu.obs import telemetry
+
+
+def serve(args) -> dict:
+    """Returns the load summary dict (tests/driver use); with --telemetry
+    the serve_request/decode_batch events stream to JSONL like train's."""
+    sink = None
+    if getattr(args, "telemetry", None):
+        sink = telemetry.JsonlSink(
+            args.telemetry,
+            depth=max(int(getattr(args, "telemetry_buffer", 1024) or 1), 1),
+        )
+        telemetry.install(sink)
+    try:
+        return _serve(args)
+    finally:
+        if sink is not None:
+            telemetry.uninstall(sink)
+            sink.close()
+
+
+def _serve(args) -> dict:
+    fam, cfg = model_config_from_args(args)
+    world = args.world_size or len(jax.devices())
+    hp = hp_config_from_args(args, cfg.num_layers, world)
+
+    # fail fast BEFORE tracing: decode-incompatible layouts (pp>1, ring cp,
+    # ulysses) refuse with GLS014; train-only knobs warn
+    from galvatron_tpu.analysis import strategy_lint as _slint
+    from galvatron_tpu.analysis.diagnostics import DiagnosticError
+
+    report = _slint.lint_hp(
+        hp, model_cfg=cfg, file=getattr(args, "galvatron_config_path", None),
+        mode="serve",
+    )
+    for d in report.warnings:
+        print("strategy lint: %s" % d.format())
+    if not report.ok:
+        raise DiagnosticError(report.errors)
+
+    if fam.build is not None:
+        raise ValueError(
+            "serving supports the generic causal-LM families only; %r "
+            "builds its own model tree" % fam.name
+        )
+
+    from galvatron_tpu.runtime.model_api import construct_hybrid_parallel_model
+    from galvatron_tpu.serve.engine import (
+        ContinuousBatcher,
+        ServeEngine,
+        replay_requests,
+        summarize,
+        synthetic_requests,
+    )
+    from galvatron_tpu.serve.kv_cache import KVCacheConfig, kv_bytes_per_slot
+
+    model = construct_hybrid_parallel_model(cfg, hp)
+    params = model.init_params(jax.random.PRNGKey(args.seed))
+    if args.load:
+        from galvatron_tpu.runtime import checkpoint as ckpt
+
+        # strategy-portable restore (tx=None => params only): a TRAIN-layout
+        # checkpoint relayouts into this serve strategy via the same
+        # machinery elastic resume uses — the saved strategy comes from the
+        # checkpoint's provenance, the target layout from `model`
+        params, _, meta = ckpt.load_checkpoint(
+            args.load, args.load_iteration, target=model, tx=None,
+        )
+        print("restored %s at iteration %s into the serve layout"
+              % (args.load, meta.get("iteration")))
+
+    # cache geometry: CLI flags win, then the strategy JSON's serve knobs,
+    # then defaults; pages default to covering the model's max_seq_len
+    max_slots = args.serve_max_concurrency or hp.serve_max_concurrency or 8
+    page = args.serve_page_size or hp.serve_page_size or 16
+    max_pages = args.serve_max_pages or -(-cfg.max_seq_len // page)
+    kv_cfg = KVCacheConfig(max_slots=max_slots, page_size=page, max_pages=max_pages)
+
+    engine = ServeEngine(
+        cfg, params, kv_cfg, hp=hp, mesh=model.mesh,
+        temperature=args.temperature, rng_seed=args.seed,
+    )
+    if args.replay:
+        reqs = replay_requests(args.replay, vocab_size=cfg.vocab_size, seed=args.seed)
+    else:
+        pmax = max(args.prompt_len_min,
+                   min(args.prompt_len_max, kv_cfg.max_ctx - args.max_new_tokens))
+        reqs = synthetic_requests(
+            args.num_requests, vocab_size=cfg.vocab_size, seed=args.seed,
+            rate_rps=args.rate_rps,
+            prompt_len_range=(args.prompt_len_min, pmax),
+            max_new_tokens=args.max_new_tokens,
+        )
+
+    batcher = ContinuousBatcher(engine, kv_cfg)
+    t0 = time.monotonic()
+    completed = batcher.run(reqs)
+    wall = time.monotonic() - t0
+
+    summary = summarize(completed, wall, world_size=hp.world_size)
+    summary["decode_steps"] = batcher.decode_steps
+    bytes_per = 2 if args.mixed_precision == "bf16" else 4
+    summary["kv_mb_per_slot"] = kv_bytes_per_slot(
+        cfg, kv_cfg.max_ctx, dtype_bytes=bytes_per) / 2**20
+    print("served %d requests in %.2f s: %.1f tok/s (%.2f tok/s/chip), "
+          "%d decode steps" % (
+              summary["requests"], wall, summary["tokens_per_s"],
+              summary["tokens_per_s_per_chip"], batcher.decode_steps))
+    for name in ("ttft_ms", "tpot_ms"):
+        p = summary[name]
+        print("%s p50/p90/p99: %.1f / %.1f / %.1f"
+              % (name, p["p50"], p["p90"], p["p99"]))
+    return summary
+
+
+def main(argv: Optional[list] = None):
+    args = initialize_galvatron(mode="serve", argv=argv)
+    return serve(args)
+
+
+if __name__ == "__main__":
+    main()

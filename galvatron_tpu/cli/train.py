@@ -220,8 +220,10 @@ def _train(args) -> dict:
     _report = _slint.lint_hp(
         hp, model_cfg=cfg, file=getattr(args, "galvatron_config_path", None),
         # driver state the strategy alone cannot see: quantized grad sync
-        # composed with the anomaly guard refuses (GLS013) before tracing
+        # composed with the anomaly guard refuses (GLS013) before tracing;
+        # mode="train" flags inert serve knobs (GLS103)
         anomaly_guard=bool(getattr(args, "anomaly_guard", 0)),
+        mode="train",
     )
     if jax.process_index() == 0:
         for _d in _report.warnings:

@@ -79,7 +79,7 @@ PER_LAYER_STR_KEYS = ("grad_comm_dtype", "param_comm_dtype")
 SCALAR_KEYS = (
     "pp_deg", "global_bsz", "chunks", "pp_division", "pipeline_type",
     "default_dp_type", "vtp", "vsp", "vcp", "embed_sdp", "cp_mode",
-    "comm_quant_block",
+    "comm_quant_block", "serve_max_concurrency", "serve_page_size",
 )
 KNOWN_STRATEGY_KEYS = frozenset(PER_LAYER_KEYS + PER_LAYER_STR_KEYS + SCALAR_KEYS)
 REQUIRED_STRATEGY_KEYS = ("pp_deg", "tp_sizes_enc", "dp_types_enc")
@@ -150,6 +150,13 @@ def schema_diagnostics(cfg: dict) -> list:
             "GLS005", "comm_quant_block must be a positive int, got %r" % (cqb,),
             key="comm_quant_block",
         ))
+    for k in ("serve_max_concurrency", "serve_page_size"):
+        sv = cfg.get(k)
+        if sv is not None and (not isinstance(sv, int) or sv < 0):
+            out.append(D.make(
+                "GLS005", "%s must be a non-negative int, got %r" % (k, sv),
+                key=k,
+            ))
     for k, lo in (("tp_sizes_enc", 1), ("cp_sizes_enc", 1)):
         for i, v in enumerate(arrays.get(k, [])):
             if v < lo:
@@ -308,6 +315,13 @@ class HybridParallelConfig:
     # for every quantized collective. Serialized (the cost models price the
     # scale overhead through it).
     comm_quant_block: int = 64
+    # Serving knobs (serve/): a serve-objective search records the KV-cache
+    # geometry its memory/latency pricing assumed — max concurrent request
+    # slots and the context-bucket page size. 0 = not a serve strategy;
+    # serialized only when set so train-objective JSONs are unchanged. In
+    # train mode these knobs are inert (GLS103).
+    serve_max_concurrency: int = 0
+    serve_page_size: int = 0
 
     def __post_init__(self):
         if self.pp_division is None:
@@ -372,6 +386,13 @@ class HybridParallelConfig:
                 "GLS005", "comm_quant_block must be a positive int, got %r"
                 % (self.comm_quant_block,), key="comm_quant_block",
             ))
+        for k in ("serve_max_concurrency", "serve_page_size"):
+            sv = getattr(self, k)
+            if not isinstance(sv, int) or sv < 0:
+                out.append(D.make(
+                    "GLS005", "%s must be a non-negative int, got %r" % (k, sv),
+                    key=k,
+                ))
         if self.pp < 1 or self.world_size % self.pp != 0:
             out.append(D.make(
                 "GLS002", "world_size %d not divisible by pp %d"
@@ -622,6 +643,8 @@ class HybridParallelConfig:
             embed_sdp=cfg.get("embed_sdp", 0),
             cp_mode=cfg.get("cp_mode", "zigzag"),
             comm_quant_block=cfg.get("comm_quant_block", 64),
+            serve_max_concurrency=cfg.get("serve_max_concurrency", 0),
+            serve_page_size=cfg.get("serve_page_size", 0),
         )
         kw.update(overrides)
         return cls(**kw)
@@ -651,7 +674,10 @@ class HybridParallelConfig:
             "grad_comm_dtype": strlist2str([s.grad_comm_dtype for s in self.layers]),
             "param_comm_dtype": strlist2str([s.param_comm_dtype for s in self.layers]),
             "comm_quant_block": self.comm_quant_block,
-        }
+        } | ({
+            "serve_max_concurrency": self.serve_max_concurrency,
+            "serve_page_size": self.serve_page_size,
+        } if self.serve_max_concurrency or self.serve_page_size else {})
 
     def save(self, path: str):
         write_json_config(self.to_json_dict(), path)

@@ -253,14 +253,20 @@ def layer_forward(
     mesh: Optional[Mesh] = None,
     axes: Optional[LayerAxes] = None,
     attn_bias: Optional[jax.Array] = None,
-) -> jax.Array:
+    return_kv: bool = False,
+):
     """One transformer block on (B, S_local, H) activations.
 
     Under GSPMD the parallel form is implied by weight shardings plus the two
     activation constraints below: seq-sharded activations (megatron-sp /
     ulysses) are re-gathered into head-sharded full-sequence tensors for
     attention (all-gather or all-to-all inserted by XLA — the hand-written
-    collectives of reference transformer.py:1928-2177)."""
+    collectives of reference transformer.py:1928-2177).
+
+    ``return_kv`` additionally returns this layer's post-rope (k, v)
+    projections — the serving prefill's cache-write side outputs
+    (serve/engine.py). Unsupported under ring context parallelism, whose
+    blockwise k/v never materialise per-layer."""
     dtype = cfg.compute_dtype
 
     residual = x
@@ -283,7 +289,14 @@ def layer_forward(
         # (ulysses) or all-gather+split (megatron-sp) when seq was tp-sharded.
         head_spec = P(S._ax(axes.batch_axes), S._ax(axes.cp), S._ax(axes.tp), None)
         q, k, v = (S.constrain(t, mesh, head_spec) for t in (q, k, v))
+    kv_out = (k, v) if return_kv else None
     if axes is not None and mesh is not None and len(axes.cp) > 0:
+        if return_kv:
+            raise ValueError(
+                "return_kv is unsupported under ring context parallelism "
+                "(cp>1): blockwise ring attention never materialises the "
+                "full per-layer k/v — serve refuses cp layouts (GLS014)"
+            )
         from galvatron_tpu.ops.ring_attention import ring_attention
 
         attn = ring_attention(
@@ -318,7 +331,90 @@ def layer_forward(
     x = residual + out
     if not cfg.pre_norm:
         x = _norm(x, p["ln2"], cfg)
+    if return_kv:
+        return x, kv_out
     return x
+
+
+def _append_token_kv(cache: jax.Array, new: jax.Array, idx: jax.Array) -> jax.Array:
+    """Write the (B, T, nkv, hd) `new` k/v block at per-row position `idx`
+    of the (B, S_cache, nkv, hd) cache (vmapped dynamic_update_slice — the
+    row dim is the vmapped dim, so a slot-sharded cache updates locally)."""
+    return jax.vmap(
+        lambda c, t, i: jax.lax.dynamic_update_slice(c, t, (i, 0, 0))
+    )(cache, new, idx)
+
+
+def decode_layer_forward(
+    p: Params,
+    x: jax.Array,
+    positions: jax.Array,
+    cfg: TransformerConfig,
+    *,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    write_index: jax.Array,
+    mesh: Optional[Mesh] = None,
+    axes: Optional[LayerAxes] = None,
+    attn_bias: Optional[jax.Array] = None,
+):
+    """One transformer block for single-token decode over a preallocated KV
+    cache. ``x``: (B, 1, H) — one new token per cache slot; ``k_cache`` /
+    ``v_cache``: (B, S_cache, nkv, hd); ``write_index``: (B,) int32, the new
+    token's position per slot. The layer projects this token's k/v, appends
+    them at ``write_index``, and attends the length-1 query against the
+    updated cache with ``attn_bias`` carrying BOTH causality and slot-length
+    masking (the causal iota mask is meaningless for a length-1 query, so
+    ``causal=False`` and the additive bias from serve/kv_cache.length_bias
+    does the whole job). Every non-attention op mirrors ``layer_forward``
+    exactly, so incremental decode reproduces the full-forward logits within
+    float tolerance (tests/serve/test_decode_parity.py)."""
+    dtype = cfg.compute_dtype
+
+    residual = x
+    y = _norm(x, p["ln1"], cfg) if cfg.pre_norm else x
+    q, k, v = qkv_projection(p, y, cfg, dtype)
+    if cfg.position_type == "rope":
+        q = apply_rotary(q, positions, cfg.rope_theta)
+        k = apply_rotary(k, positions, cfg.rope_theta)
+    k_cache = _append_token_kv(k_cache, k.astype(k_cache.dtype), write_index)
+    v_cache = _append_token_kv(v_cache, v.astype(v_cache.dtype), write_index)
+    if mesh is not None and axes is not None and len(axes.tp) > 0:
+        # decode head layout: slots on the batch axes, kv-heads on tp (the
+        # cache's own layout, serve/kv_cache.layer_kv_spec); no cp/seq axes —
+        # serve refuses those layouts before tracing (GLS014)
+        head_spec = P(S._ax(axes.batch_axes), None, S._ax(axes.tp), None)
+        q = S.constrain(q, mesh, head_spec)
+        k_cache = S.constrain(k_cache, mesh, head_spec)
+        v_cache = S.constrain(v_cache, mesh, head_spec)
+    attn = core_attention(
+        q, k_cache.astype(dtype), v_cache.astype(dtype), causal=False,
+        bias=attn_bias, impl=cfg.attn_impl,
+    )
+    attn = attn.reshape(attn.shape[0], attn.shape[1], cfg.num_heads * cfg.head_dim)
+    o = _dense(attn, p["wo"], dtype)
+    if mesh is not None and axes is not None:
+        o = S.constrain(o, mesh, P(S._ax(axes.batch_axes), None, None))
+    x = residual + o
+    if not cfg.pre_norm:
+        x = _norm(x, p["ln1"], cfg)
+
+    residual = x
+    y = _norm(x, p["ln2"], cfg) if cfg.pre_norm else x
+    wi_out = jnp.einsum("bsh,h...->bs...", y, p["wi"]["kernel"].astype(dtype))
+    if "bias" in p["wi"]:
+        wi_out = wi_out + p["wi"]["bias"].astype(dtype)
+    if cfg.activation == "swiglu":
+        hmid = jax.nn.silu(wi_out[:, :, 0]) * wi_out[:, :, 1]
+    else:
+        hmid = _activation(wi_out, cfg)
+    out = _dense(hmid, p["wo_mlp"], dtype)
+    if mesh is not None and axes is not None:
+        out = S.constrain(out, mesh, P(S._ax(axes.batch_axes), None, None))
+    x = residual + out
+    if not cfg.pre_norm:
+        x = _norm(x, p["ln2"], cfg)
+    return x, k_cache, v_cache
 
 
 # ============================================================== model forward
@@ -499,7 +595,8 @@ def run_layers(
     mesh: Optional[Mesh] = None,
     attn_bias: Optional[jax.Array] = None,
     scan: Optional[bool] = None,
-) -> jax.Array:
+    collect_kv: bool = False,
+):
     """The encoder stack with per-layer sharding constraints and remat.
 
     Layers are partitioned into maximal same-strategy runs
@@ -509,12 +606,20 @@ def run_layers(
     depth. Strategy boundaries and length-1 runs fall back to the unrolled
     per-layer path; `scan=False` (or `hp.scan_layers=False`, the
     `--no_scan_layers` escape hatch) unrolls everything, reproducing the
-    pre-scan trace exactly."""
+    pre-scan trace exactly.
+
+    ``collect_kv=True`` (the serving prefill, serve/engine.py) additionally
+    returns one post-rope (k, v) pair per layer, in layer order — scan runs
+    emit them as stacked side outputs of the SAME scan, so prefill keeps the
+    depth-constant trace. The collecting path is GSPMD-only and forward-only
+    (no manual-TP shard_map body, no remat): serve lints away the layouts
+    that would need either."""
     use_hp = hp is not None and mesh is not None
     layers = params["layers"]
     if scan is None:
         scan = hp.scan_layers if hp is not None else True
     policy = hp.remat_policy if hp is not None else "full"
+    kvs: List[Tuple[jax.Array, jax.Array]] = []
 
     def unrolled(x, indices):
         for i in indices:
@@ -522,6 +627,13 @@ def run_layers(
             axes = layer_axes(hp, i) if use_hp else None
             if use_hp:
                 x = S.constrain(x, mesh, S.act_spec(axes))
+            if collect_kv:
+                x, kv = layer_forward(
+                    lp, x, positions, cfg, mesh=mesh, axes=axes,
+                    attn_bias=attn_bias, return_kv=True,
+                )
+                kvs.append(kv)
+                continue
             fwd = _layer_fwd_fn(cfg, hp if use_hp else None, mesh, axes,
                                 attn_bias, hp.layers[i] if use_hp else None)
             if use_hp and hp.layers[i].checkpoint and policy != "none":
@@ -545,6 +657,20 @@ def run_layers(
                 lambda t, sp: S.constrain(t, mesh, sp),
                 stacked, stacked_layer_param_specs(cfg, axes),
             )
+        if collect_kv:
+            body = partial(layer_forward, cfg=cfg, mesh=mesh, axes=axes,
+                           attn_bias=attn_bias, return_kv=True)
+
+            def step_kv(carry, lp, _body=body, _axes=axes):
+                if use_hp:
+                    carry = S.constrain(carry, mesh, S.act_spec(_axes))
+                out, kv = _body(lp, carry, positions)
+                return out, kv
+
+            x, kv_stacked = jax.lax.scan(step_kv, x, stacked)
+            for j in range(run.length):
+                kvs.append(jax.tree.map(lambda t, _j=j: t[_j], kv_stacked))
+            continue
         body = _layer_fwd_fn(cfg, hp if use_hp else None, mesh, axes,
                              attn_bias, run.strategy if use_hp else None)
         if use_hp and run.strategy.checkpoint and policy != "none":
@@ -556,6 +682,8 @@ def run_layers(
             return _body(lp, carry, positions), None
 
         x, _ = jax.lax.scan(step, x, stacked)
+    if collect_kv:
+        return x, kvs
     return x
 
 

@@ -17,6 +17,9 @@ produces the numbers the perf loop runs on:
 - **divergence table** — the per-LayerRun predicted-vs-measured join
   (obs/attribution.py) using the steady step time and the compiled-step
   memory recorded by the ``compile`` event.
+- **serving rollup** — when the stream carries ``serve_request`` /
+  ``decode_batch`` events (``cli serve --telemetry``): TTFT/TPOT
+  percentiles, decode-step occupancy, and output tokens/s.
 
 Exit-code contract (shared with the GLS/GLC lint framework): 0 = analyzed
 clean, 1 = schema violations in the stream, 2 = usage/IO failure.
@@ -72,6 +75,40 @@ def detect_steady_state(
 def _median(vals: Sequence[float]) -> Optional[float]:
     vals = [v for v in vals if v is not None]
     return float(statistics.median(vals)) if vals else None
+
+
+def _percentile(vals: Sequence[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile (same convention as serve/engine.percentile)."""
+    vals = sorted(v for v in vals if v is not None)
+    if not vals:
+        return None
+    k = min(len(vals) - 1, max(0, int(round(q / 100.0 * (len(vals) - 1)))))
+    return float(vals[k])
+
+
+def _serving_section(
+    reqs: List[Dict[str, Any]], batches: List[Dict[str, Any]]
+) -> Dict[str, Any]:
+    """Latency/throughput rollup of serve_request + decode_batch events."""
+    ttft = [e.get("ttft_ms") for e in reqs]
+    tpot = [e.get("tpot_ms") for e in reqs]
+    out_tokens = sum(e.get("output_len") or 0 for e in reqs)
+    arrivals = [e.get("arrival_t") for e in reqs if e.get("arrival_t") is not None]
+    dones = [e.get("done_t") for e in reqs if e.get("done_t") is not None]
+    span = (max(dones) - min(arrivals)) if arrivals and dones else None
+    occ = [e["occupancy"] for e in batches if e.get("occupancy") is not None]
+    return {
+        "requests": len(reqs),
+        "output_tokens": out_tokens,
+        "tokens_per_s": (out_tokens / span) if span else None,
+        "ttft_ms": {q: _percentile(ttft, n) for q, n in
+                    (("p50", 50), ("p90", 90), ("p99", 99))},
+        "tpot_ms": {q: _percentile(tpot, n) for q, n in
+                    (("p50", 50), ("p90", 90), ("p99", 99))},
+        "decode_steps": len(batches),
+        "median_step_ms": _median([e.get("step_ms") for e in batches]),
+        "mean_occupancy": (statistics.fmean(occ) if occ else None),
+    }
 
 
 # -------------------------------------------------------------- analysis
@@ -181,6 +218,10 @@ def analyze(
         "quant_comm": quant_events,
         "timeline": timeline,
     }
+    serve_reqs = by_type.get("serve_request", [])
+    decode_batches = by_type.get("decode_batch", [])
+    if serve_reqs or decode_batches:
+        analysis["serving"] = _serving_section(serve_reqs, decode_batches)
     run_end = by_type.get("run_end")
     if run_end and run_end[-1].get("summary") is not None:
         analysis["summary"] = run_end[-1]["summary"]
@@ -265,6 +306,23 @@ def render(analysis: Dict[str, Any]) -> str:
                    _fmt(e.get("stop", 1) - 1 if e.get("stop") is not None else None),
                    _fmt(e.get("overlap_ms")), _fmt(e.get("serial_ms")),
                    _fmt(e.get("comm_hidden_ms")))
+            )
+    if analysis.get("serving"):
+        sv = analysis["serving"]
+        lines.append("")
+        lines.append("serving:")
+        lines.append(
+            "  %s requests, %s output tokens, %s tok/s | %s decode steps, "
+            "median step %s ms, mean occupancy %s"
+            % (_fmt(sv["requests"]), _fmt(sv["output_tokens"]),
+               _fmt(sv["tokens_per_s"]), _fmt(sv["decode_steps"]),
+               _fmt(sv["median_step_ms"]), _fmt(sv["mean_occupancy"]))
+        )
+        for name in ("ttft_ms", "tpot_ms"):
+            p = sv[name]
+            lines.append(
+                "  %s p50/p90/p99: %s / %s / %s"
+                % (name, _fmt(p["p50"]), _fmt(p["p90"]), _fmt(p["p99"]))
             )
     if analysis["timeline"]:
         lines.append("")

@@ -133,6 +133,19 @@ EVENT_SCHEMAS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
         ("param_comm_dtype", "comm_quant_block", "tp_comm_quant",
          "quant_overhead_ms", "wire_mb_fp32", "wire_mb_configured"),
     ),
+    # serving (serve/engine.ContinuousBatcher): one per completed request —
+    # the raw timestamps (seconds on the batcher clock) plus the derived
+    # latencies, so the report can recompute percentiles from either
+    "serve_request": (
+        ("id",),
+        ("arrival_t", "prefill_start_t", "first_token_t", "done_t",
+         "prompt_len", "output_len", "ttft_ms", "tpot_ms"),
+    ),
+    # one per decode tick: batch occupancy + the bucket it routed to
+    "decode_batch": (
+        ("step",),
+        ("occupancy", "slots", "step_ms", "bucket_pages", "tokens"),
+    ),
     # jax.profiler start/stop_trace bracketing (--xla_trace)
     "trace": (("action",), ("dir", "first_step", "last_step", "error")),
     "log": (("message",), ()),

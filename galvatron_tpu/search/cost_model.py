@@ -533,6 +533,129 @@ class TimeCostModel:
         return fwd + bwd
 
 
+class ServeTimeCostModel:
+    """Prefill/decode latency (ms) for one uniform serving strategy
+    (``--objective serve``, ROADMAP item 4).
+
+    Serving has no backward pass, so the train-time model does not apply;
+    the two phases sit on opposite ends of the roofline:
+
+    - Prefill (compute-bound): one request's full-prompt forward — the
+      profiled per-layer forward fit at one sequence, compute sharded
+      tp-fold exactly like TimeCostModel, plus the forward half of the
+      megatron-sp activation collectives (2 of the 4 per layer).
+    - Decode (bandwidth-bound): one step of a ``concurrency``-slot batch
+      emits one token per slot. Arithmetic intensity is ~1, so the step
+      floor is HBM reads: every device streams its weight shard plus its
+      slots' KV pages once per step (MB / (GB/s) ~= ms), plus one small
+      activation allreduce per layer under tp (priced from the profiled
+      table at the batch x one-token message, where the fit's latency
+      intercept dominates).
+
+    KV bytes approximate num_kv_heads*head_dim == hidden_size; pass
+    ``kv_frac = num_kv_heads / num_heads`` to shrink for GQA. The serve
+    engine rejects cp/ulysses/pp layouts (GLS014), so this model only
+    prices pp=1 tp x dp strategies; ZeRO-3 (fsdp) layouts additionally pay
+    a per-step weight all-gather that buries decode — priced, not banned,
+    so the search itself demonstrates why they lose.
+    """
+
+    def __init__(
+        self,
+        strategy,
+        *,
+        concurrency: int,
+        max_ctx: int,
+        hbm_gbps: float = 100.0,
+        kv_frac: float = 1.0,
+        model_args: ModelArgs = None,
+        train_args: TrainArgs = None,
+        profile_model_args: ProfileModelArgs = None,
+        profile_hardware_args: ProfileHardwareArgs = None,
+    ):
+        ma, ta, pma, pha = model_args, train_args, profile_model_args, profile_hardware_args
+        self.tp_size, self.dp_size = strategy[1], strategy[2]
+        info = _info(strategy)
+        self.fsdp = bool(info.get("fsdp", 0))
+        self.consec = bool(info.get("tp", 1))
+        self.layer_num = ma.layer_num or 24
+        bytes_per = 2.0 if ta.mixed_precision else 4.0
+
+        def tp_allreduce_ms(message_mb: float) -> float:
+            if self.tp_size <= 1:
+                return 0.0
+            if pha.allreduce_dict:
+                return _table_time(pha.allreduce_dict, self.tp_size, message_mb)
+            vol = 2 * (self.tp_size - 1) / self.tp_size * message_mb
+            return vol * comm_coe(pha.comm_coe_dict, self.tp_size, consec=self.consec)
+
+        # ---- prefill: one sequence, compute tp-sharded ---------------------
+        self.prefill_compute = (
+            _eval_fit(pma.forward_computation_time, 1.0 / self.tp_size) * self.layer_num
+        )
+        act_mb = ma.seq_length * ma.hidden_size * bytes_per / 1024 / 1024
+        self.prefill_comm = 2.0 * tp_allreduce_ms(act_mb) * self.layer_num
+
+        # ---- decode: HBM-read roofline -------------------------------------
+        param_mb_dev = ma.parameter_size * (bytes_per / 4.0) / self.tp_size * self.layer_num
+        slots_dev = concurrency / max(self.dp_size, 1)
+        kv_mb_dev = (
+            2.0 * slots_dev * max_ctx * ma.hidden_size * kv_frac * bytes_per
+            / self.tp_size / 1024 / 1024 * self.layer_num
+        )
+        self.decode_read_ms = (param_mb_dev + kv_mb_dev) / max(hbm_gbps, 1e-9)
+        tok_mb = slots_dev * ma.hidden_size * bytes_per / 1024 / 1024
+        self.decode_comm = 2.0 * tp_allreduce_ms(tok_mb) * self.layer_num
+        if self.fsdp and self.dp_size > 1:
+            # ZeRO-3: the full weight shard crosses the wire every step
+            gather_mb = (self.dp_size - 1) / self.dp_size * param_mb_dev
+            self.decode_comm += gather_mb * comm_coe(pha.comm_coe_dict, self.dp_size)
+
+    def gen_result(self) -> Dict[str, float]:
+        prefill_ms = self.prefill_compute + self.prefill_comm
+        decode_ms = self.decode_read_ms + self.decode_comm
+        return {
+            "prefill_ms": prefill_ms,
+            "decode_ms": decode_ms,
+            # first token = prompt forward + the sampling step's decode tick
+            "ttft_ms": prefill_ms + decode_ms,
+            "tpot_ms": decode_ms,
+        }
+
+
+def serve_memory_mb(
+    strategy,
+    *,
+    concurrency: int,
+    max_ctx: int,
+    kv_frac: float = 1.0,
+    model_args: ModelArgs = None,
+    train_args: TrainArgs = None,
+) -> float:
+    """Per-device resident MB for serving one layer type: the compute-dtype
+    weight shard plus the KV cache for this device's slots. No grads, no
+    optimizer states, and decode activations are one token — KV is the only
+    batch-scaling term (the runtime twin is
+    analysis/strategy_lint.serve_kv_mb_per_device, which sees real head
+    counts; here GQA enters through ``kv_frac``)."""
+    ma, ta = model_args, train_args
+    tp, dp = strategy[1], strategy[2]
+    info = _info(strategy)
+    bytes_per = 2.0 if ta.mixed_precision else 4.0
+    layer_param_mb = ma.parameter_size * (bytes_per / 4.0) / tp
+    param_mb = layer_param_mb * ma.layer_num
+    if info.get("fsdp", 0):
+        # ZeRO-3 shards the resident copy dp-fold but gathers one layer's
+        # full shard transiently every decode tick
+        param_mb = param_mb / max(dp, 1) + layer_param_mb
+    slots_dev = concurrency / max(dp, 1)
+    kv_mb = (
+        2.0 * slots_dev * max_ctx * ma.hidden_size * kv_frac * bytes_per
+        / tp / 1024 / 1024 * ma.layer_num
+    )
+    return param_mb + kv_mb
+
+
 class OtherTimeCostModel:
     """Embedding/cls stage time per candidate vocab-tp (reference
     OtherTimeCostModel, cost_model.py:468-658, re-derived): per affected

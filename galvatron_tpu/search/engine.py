@@ -22,7 +22,9 @@ from galvatron_tpu.config.strategy import HybridParallelConfig, LayerStrategy
 from galvatron_tpu.search.cost_model import (
     MemoryCostModel,
     OtherTimeCostModel,
+    ServeTimeCostModel,
     TimeCostModel,
+    serve_memory_mb,
 )
 from galvatron_tpu.search.cost_model_args import (
     ModelArgs,
@@ -78,6 +80,17 @@ class SearchArgs:
     comm_quant: str = "off"  # off | bf16 | int8 | fp8_e4m3
     comm_quant_block: int = 64
     comm_quant_budget: float = 1.0  # max fraction of layers quantized
+    # latency-aware serving objective (ROADMAP item 4): "train" keeps the
+    # classic throughput DP; "serve" prices prefill (compute-bound) and
+    # decode (bandwidth-bound) separately over the decode-compatible subset
+    # of the space and maximises decode tokens/s/chip under the p99 bounds
+    objective: str = "train"  # train | serve
+    p99_ttft_ms: float = 0.0  # p99 time-to-first-token bound, ms (0 = unbounded)
+    p99_tpot_ms: float = 0.0  # p99 time-per-output-token bound, ms (0 = unbounded)
+    serve_max_concurrency: int = 8  # decode slots the engine holds KV for
+    serve_page_size: int = 16  # KV page granularity (contexts round up)
+    serve_hbm_gbps: float = 100.0  # per-chip HBM read bandwidth (decode roofline)
+    serve_kv_frac: float = 1.0  # num_kv_heads / num_heads (GQA KV shrink)
 
 
 class _TaskLog:
@@ -665,6 +678,104 @@ class GalvatronSearchEngine:
         self.best = best
         return best
 
+    def serve_optimization(self) -> dict:
+        """Latency-aware serving objective (``--objective serve``): enumerate
+        the decode-compatible subset of the strategy space (pp=1, no cp, no
+        ulysses, no activation checkpointing, no quantized collectives — the
+        serve engine's layout contract, mirrored by GLS014), price prefill
+        and decode per candidate with ServeTimeCostModel, and maximise
+        decode tokens/s/chip subject to the weight+KV memory budget and the
+        optional p99 TTFT / TPOT bounds. Raises a GLS014 DiagnosticError
+        when nothing survives, carrying the nearest-miss rejections so the
+        user sees WHICH bound refused, not just that one did."""
+        a = self.args
+        ma_list, ta_list, _, pma_list, pha_list = self._bundles(1)
+        max_ctx = max(lc["seq_len"] for lc in self.layer_configs)
+        if a.serve_page_size > 0:
+            # the KV cache is paged: contexts occupy whole pages
+            max_ctx = -(-max_ctx // a.serve_page_size) * a.serve_page_size
+
+        def decode_compatible(s):
+            info = s[3] if len(s) > 3 else {}
+            return (
+                s[0] == 1
+                and info.get("cp", 1) == 1
+                and not info.get("sp", 0)
+                and not info.get("cpt", 0)
+                and info.get("gcd", "none") == "none"
+                and info.get("pcd", "none") == "none"
+                # every dp replica needs a whole number of KV slots
+                and s[2] <= a.serve_max_concurrency
+                and a.serve_max_concurrency % s[2] == 0
+            )
+
+        candidates = [s for s in self.strategies if decode_compatible(s)]
+        budget_mb = a.memory_constraint * 1024.0
+        best, rejections = None, []
+        for s in candidates:
+            prefill = decode = mem = 0.0
+            for t in range(self.num_layertype):
+                r = ServeTimeCostModel(
+                    s, concurrency=a.serve_max_concurrency, max_ctx=max_ctx,
+                    hbm_gbps=a.serve_hbm_gbps, kv_frac=a.serve_kv_frac,
+                    model_args=ma_list[t], train_args=ta_list[t],
+                    profile_model_args=pma_list[t],
+                    profile_hardware_args=pha_list[t],
+                ).gen_result()
+                prefill += r["prefill_ms"]
+                decode += r["decode_ms"]
+                mem += serve_memory_mb(
+                    s, concurrency=a.serve_max_concurrency, max_ctx=max_ctx,
+                    kv_frac=a.serve_kv_frac,
+                    model_args=ma_list[t], train_args=ta_list[t],
+                )
+            ttft, tpot = prefill + decode, decode
+            label = form_strategy(s)
+            if mem > budget_mb:
+                rejections.append("%s: %.0f MB > %.0f MB budget" % (label, mem, budget_mb))
+                continue
+            if a.p99_ttft_ms > 0 and ttft > a.p99_ttft_ms:
+                rejections.append("%s: TTFT %.1f ms > %.1f ms" % (label, ttft, a.p99_ttft_ms))
+                continue
+            if a.p99_tpot_ms > 0 and tpot > a.p99_tpot_ms:
+                rejections.append("%s: TPOT %.1f ms > %.1f ms" % (label, tpot, a.p99_tpot_ms))
+                continue
+            tput = a.serve_max_concurrency / decode * 1000.0 / self.world_size
+            if best is None or tput > best["serve"]["tokens_per_s_per_chip"]:
+                n_layers = sum(lc["layer_num"] for lc in self.layer_configs)
+                best = dict(
+                    cost=decode,
+                    strategies=[list(s) for _ in range(n_layers)],
+                    pp=1, bsz=a.serve_max_concurrency, chunks=1,
+                    vtp=1, vsp=0, embed_sdp=0, pp_division=None,
+                    serve=dict(
+                        prefill_ms=prefill, decode_ms=decode,
+                        ttft_ms=ttft, tpot_ms=tpot, memory_mb=mem,
+                        tokens_per_s_per_chip=tput, max_ctx=max_ctx,
+                        concurrency=a.serve_max_concurrency,
+                    ),
+                )
+        if best is None:
+            from galvatron_tpu.analysis.diagnostics import DiagnosticError, make
+
+            detail = "; ".join(rejections[:4]) if rejections else \
+                "no decode-compatible strategy in the search space"
+            raise DiagnosticError([make(
+                "GLS014",
+                "no feasible serving strategy for world_size=%d under budget "
+                "%.1f GB, p99_ttft<=%s ms, p99_tpot<=%s ms (%s)" % (
+                    self.world_size, a.memory_constraint,
+                    ("%.0f" % a.p99_ttft_ms) if a.p99_ttft_ms > 0 else "inf",
+                    ("%.0f" % a.p99_tpot_ms) if a.p99_tpot_ms > 0 else "inf",
+                    detail,
+                ),
+                key="objective",
+            )])
+        if self.logger:
+            self.logger.info("serve winner: %s" % best["serve"])
+        self.best = best
+        return best
+
     # ------------------------------------------------------------------- save
     def result_to_config(self, result: dict) -> HybridParallelConfig:
         layers = []
@@ -695,6 +806,16 @@ class GalvatronSearchEngine:
             vocab_sp=result["vsp"],
             embed_sdp=int(result["embed_sdp"]),
             comm_quant_block=self.args.comm_quant_block,
+            # a serve-objective winner carries its KV sizing so `cli serve`
+            # (and the serve linter's budget check) sees the searched values
+            serve_max_concurrency=(
+                self.args.serve_max_concurrency
+                if self.args.objective == "serve" else 0
+            ),
+            serve_page_size=(
+                self.args.serve_page_size
+                if self.args.objective == "serve" else 0
+            ),
         )
 
     def save_results(self, result: dict, path: Optional[str] = None) -> str:
@@ -706,7 +827,8 @@ class GalvatronSearchEngine:
         # task log / stdout.
         from galvatron_tpu.analysis import strategy_lint as _slint
 
-        report = _slint.lint_hp(cfg)
+        report = _slint.lint_hp(
+            cfg, mode="serve" if self.args.objective == "serve" else None)
         for d in report.warnings:
             (self.logger.info if self.logger else print)("strategy lint: %s" % d.format())
         if not report.ok:

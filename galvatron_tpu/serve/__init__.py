@@ -1,0 +1,25 @@
+"""Serving subsystem: searched-strategy inference (ROADMAP item 4).
+
+The training side of this repo searches per-layer hybrid strategies and
+executes them via GSPMD; serving reuses the same strategy JSONs, the same
+model functions, and the same relayout machinery, with the objective flipped
+from MFU to tokens/s/chip under a latency bound:
+
+- kv_cache.py: preallocated slot-based KV cache whose per-layer sharding is
+  derived from that layer's searched strategy.
+- engine.py: prefill/decode split, bucketed AOT executables, continuous
+  batching, greedy/temperature sampling.
+
+Driver: ``python -m galvatron_tpu.cli serve`` (cli/serve.py); search-side
+objective: ``search --objective serve`` (search/engine.py).
+"""
+
+from galvatron_tpu.serve.kv_cache import (  # noqa: F401
+    KVCacheConfig,
+    bucket_pages,
+    init_kv_cache,
+    kv_bytes_per_slot,
+    kv_cache_specs,
+    layer_kv_spec,
+    length_bias,
+)

@@ -72,10 +72,21 @@ def test_warnings_pass_unless_strict(capsys):
     capsys.readouterr()
 
 
+def test_serve_mode_flag(capsys):
+    """--serve turns on the GLS014 feasibility layer: the shipped serve
+    strategy passes, a pp=2 layout with serve knobs is refused."""
+    assert run([fx("valid/serve_tp2.json"), "--world_size", "8",
+                "--serve"]) == 0
+    capsys.readouterr()
+    assert run([fx("broken/gls014_serve_pp.json"), "--world_size", "8",
+                "--serve"]) == 1
+    assert "GLS014" in capsys.readouterr().out
+
+
 def test_explain_prints_code_table(capsys):
     assert run(["--explain"]) == 0
     out = capsys.readouterr().out
-    for code in ("GLS001", "GLS101", "GLC001", "GLC004"):
+    for code in ("GLS001", "GLS014", "GLS101", "GLC001", "GLC004"):
         assert code in out
 
 

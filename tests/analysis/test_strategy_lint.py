@@ -39,6 +39,7 @@ BROKEN = {
     "GLS010": ("broken/gls010_gpipe_nonuniform.json", {}),
     "GLS011": ("broken/gls011_ckpt_nonuniform.json", {}),
     "GLS013": ("broken/gls013_quant_unsupported.json", {}),
+    "GLS014": ("broken/gls014_serve_pp.json", {"mode": "serve"}),
 }
 WARN = {
     "GLS101": ("warn/gls101_over_budget.json",
@@ -85,6 +86,35 @@ def test_valid_corpus_clean_with_model_and_budget():
     report = lint("valid/uniform_dp8.json", model_cfg=MODEL,
                   memory_budget_gb=1024.0)
     assert report.ok and not report.warnings, report.render()
+
+
+def test_serve_fixture_clean_in_serve_mode():
+    """The shipped serve strategy lints clean under the FULL serve layer
+    (model-aware KV budget included) — and stays clean in the default
+    file-level mode lint.sh runs."""
+    report = lint("valid/serve_tp2.json", model_cfg=MODEL, mode="serve",
+                  memory_budget_gb=64.0)
+    assert report.ok and not report.warnings, report.render()
+    report = lint("valid/serve_tp2.json")
+    assert report.ok and not report.warnings, report.render()
+
+
+def test_serve_kv_budget_overflow_is_gls014():
+    """Same valid layout, starvation budget: the KV+weight budget check
+    refuses with GLS014 rather than emitting a doomed serving config."""
+    report = lint("valid/serve_tp2.json", model_cfg=MODEL, mode="serve",
+                  memory_budget_gb=0.0001)
+    assert not report.ok and "GLS014" in report.codes(), report.render()
+
+
+def test_serve_knobs_warn_inert_in_train_mode():
+    """GLS103's serve-flag variant: serve_max_concurrency/serve_page_size in
+    a config consumed by the TRAIN driver warn (nothing allocates a cache)."""
+    report = lint("warn/gls103_serve_knobs.json", mode="train")
+    assert report.ok, report.render()
+    assert "GLS103" in {d.code for d in report.warnings}, report.render()
+    # without driver mode context the knobs are dormant, not diagnosable
+    assert not lint("warn/gls103_serve_knobs.json").warnings
 
 
 def test_ring_nonuniform_second_gls010_variant():

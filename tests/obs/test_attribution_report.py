@@ -141,6 +141,29 @@ def test_report_analyze_golden_steady_state_and_divergence():
     assert analysis["anomalies"] == {"skipped": 1, "rollbacks": 1, "retries": 1}
 
 
+def test_report_serving_section_from_golden():
+    """The golden stream's serve_request/decode_batch events roll up into
+    the serving section: TTFT/TPOT percentiles, occupancy, tokens/s."""
+    events, errors = T.read_events(GOLDEN)
+    assert errors == []
+    analysis = R.analyze(events)
+    sv = analysis["serving"]
+    assert sv["requests"] == 2 and sv["output_tokens"] == 20
+    # span = last done_t - first arrival_t = 0.5 s over 20 tokens
+    assert sv["tokens_per_s"] == pytest.approx(40.0, rel=1e-6)
+    assert sv["ttft_ms"]["p50"] == pytest.approx(50.0)
+    assert sv["ttft_ms"]["p99"] == pytest.approx(80.0)
+    assert sv["decode_steps"] == 2
+    assert sv["median_step_ms"] == pytest.approx(28.5)
+    assert sv["mean_occupancy"] == pytest.approx((2 / 4 + 1 / 4) / 2)
+    text = R.render(analysis)
+    assert "serving:" in text and "tpot_ms p50/p90/p99" in text
+    # train-only streams carry no serving section
+    train_only = [e for e in events
+                  if e["type"] not in ("serve_request", "decode_batch")]
+    assert "serving" not in R.analyze(train_only)
+
+
 def test_steady_state_detection_edges():
     assert R.detect_steady_state([]) == (None, "empty")
     # monotone noise never settles -> fallback tail

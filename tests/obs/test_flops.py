@@ -104,6 +104,65 @@ def test_run_fwd_flops_shares_sum_to_one():
     assert total == pytest.approx(F.model_fwd_flops(cfg, 8))
 
 
+def test_decode_step_flops_kv_aware_no_train_multiplier():
+    cfg = tiny_cfg()
+    one = F.decode_step_flops(cfg, batch_size=1, context_len=16)
+    assert one is not None and one > 0
+    # forward-only: far below even one-eighth of a train step per token
+    assert one < F.train_step_flops(cfg, 1) / 3
+    # matmul flops scale linearly in batch; attention linearly in context
+    assert F.decode_step_flops(cfg, batch_size=4, context_len=16) \
+        == pytest.approx(4 * one)
+    grown = F.decode_step_flops(cfg, batch_size=1, context_len=32)
+    assert one < grown < 2 * one  # only the attention term grows with ctx
+    # the context term prices the FULL cache (no causal 0.5 discount):
+    # +16 ctx adds 2*(2*16*q_dim) score+weighted-sum flops per layer
+    q_dim = cfg.num_heads * cfg.head_dim
+    assert grown - one == pytest.approx(cfg.num_layers * 2 * (2 * 16 * q_dim))
+    assert F.decode_step_flops(object()) is None
+
+
+def test_model_bytes_per_decode_token_roofline_terms():
+    cfg = tiny_cfg()
+    b1 = F.model_bytes_per_decode_token(cfg, context_len=16, dtype_bytes=2)
+    b4 = F.model_bytes_per_decode_token(cfg, context_len=16, dtype_bytes=2,
+                                        batch_size=4)
+    kv = 2.0 * cfg.num_layers * 16 * cfg.num_kv_heads * cfg.head_dim * 2
+    # weights amortise over the batch; the KV read never does
+    assert b1 > b4 > kv
+    assert b4 - kv == pytest.approx((b1 - kv) / 4)
+    # fp32 wire doubles every term
+    assert F.model_bytes_per_decode_token(cfg, context_len=16, dtype_bytes=4) \
+        == pytest.approx(2 * b1)
+    assert F.model_bytes_per_decode_token(object()) is None
+
+
+def test_decode_step_flops_matches_xla_cost_analysis():
+    """Same acceptance band as the training forward: the analytic decode
+    count must agree with XLA's own count of the lowered single-token step
+    (batch of slots vs a full cache)."""
+    cfg = tiny_cfg(num_layers=1)
+    slots, ctx = 4, 32
+    params = M.init_model_params(jax.random.PRNGKey(0), cfg)
+    k = jnp.zeros((slots, ctx, cfg.num_kv_heads, cfg.head_dim), jnp.float32)
+    tokens = jnp.zeros((slots,), jnp.int32)
+    lengths = jnp.full((slots,), ctx - 1, jnp.int32)
+
+    def decode(p, t, kc, vc, ln):
+        x = M.embed_tokens(p["embed"], t[:, None], ln[:, None], cfg)
+        x, _, _ = M.decode_layer_forward(
+            p["layers"][0], x, ln[:, None], cfg, k_cache=kc, v_cache=vc,
+            write_index=ln)
+        return M.lm_logits(p, x, cfg)
+
+    compiled = jax.jit(decode).lower(params, tokens, k, k, lengths).compile()
+    reported = F.xla_flops(compiled)
+    if reported is None:
+        pytest.skip("backend reports no flops in cost_analysis")
+    analytic = F.decode_step_flops(cfg, batch_size=slots, context_len=ctx)
+    assert 0.5 * reported <= analytic <= 1.25 * reported, (analytic, reported)
+
+
 def test_xla_flops_handles_unreportable_objects():
     class NoAnalysis:
         def cost_analysis(self):

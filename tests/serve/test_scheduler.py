@@ -1,0 +1,120 @@
+"""ContinuousBatcher invariants with a pure-host fake engine + fake clock
+(no jax compilation): FIFO admission, slot hygiene, bucket routing, and the
+oversize-request refusal."""
+
+import numpy as np
+import pytest
+
+from galvatron_tpu.serve.engine import (
+    ContinuousBatcher,
+    Request,
+    summarize,
+    synthetic_requests,
+)
+from galvatron_tpu.serve.kv_cache import KVCacheConfig
+
+pytestmark = [pytest.mark.serve]
+
+
+class FakeClock:
+    """Monotonic counter advancing a fixed dt per read, so arrival gaps
+    resolve by spinning instead of sleeping."""
+
+    def __init__(self, dt=0.001):
+        self.t = 0.0
+        self.dt = dt
+
+    def __call__(self):
+        self.t += self.dt
+        return self.t
+
+
+class FakeEngine:
+    """ServeEngine double recording the scheduler-visible call surface."""
+
+    def __init__(self, vocab=32):
+        self.vocab = vocab
+        self.prefills = []  # (slot, prompt)
+        self.decode_pages = []
+        self.decode_active = []
+
+    def prefill(self, prompt, slot):
+        self.prefills.append((slot, list(prompt)))
+        return int(sum(prompt) % self.vocab), np.zeros((self.vocab,), np.float32)
+
+    def decode_step(self, tokens, active, pages):
+        self.decode_pages.append(pages)
+        self.decode_active.append(np.array(active))
+        nxt = (np.asarray(tokens, np.int64) + 1) % self.vocab
+        return nxt.astype(np.int32), np.zeros((len(tokens), self.vocab), np.float32)
+
+
+def backlog(n, plen=3, new=4):
+    """n requests all arrived at t=0 with identifying prompts [rid]*plen."""
+    return [Request(rid=i, arrival_s=0.0, prompt=[i % 31] * plen,
+                    max_new_tokens=new) for i in range(n)]
+
+
+def test_fifo_admission_under_slot_pressure():
+    eng = FakeEngine()
+    kv = KVCacheConfig(max_slots=2, page_size=8, max_pages=2)
+    b = ContinuousBatcher(eng, kv, clock=FakeClock())
+    done = b.run(backlog(6))
+    assert len(done) == 6
+    # prefill order == arrival (rid) order even though only 2 slots exist
+    assert [p[0] for _, p in eng.prefills] == list(range(6))
+
+
+def test_no_slot_leak_or_double_occupancy():
+    eng = FakeEngine()
+    kv = KVCacheConfig(max_slots=3, page_size=8, max_pages=2)
+    b = ContinuousBatcher(eng, kv, clock=FakeClock())
+    real_prefill = eng.prefill
+
+    def checked_prefill(prompt, slot):
+        assert b.slot_req[slot] is None, "slot %d doubly occupied" % slot
+        return real_prefill(prompt, slot)
+
+    eng.prefill = checked_prefill
+    done = b.run(backlog(7, new=3))
+    assert sorted(r.rid for r in done) == list(range(7))
+    assert all(r is None for r in b.slot_req)  # every slot freed
+    assert all(len(r.output) == r.max_new_tokens for r in done)
+    # decode ticks never ran with zero active slots
+    assert all(a.any() for a in eng.decode_active)
+
+
+def test_bucket_routing_tracks_active_write_positions():
+    eng = FakeEngine()
+    kv = KVCacheConfig(max_slots=1, page_size=4, max_pages=4)
+    b = ContinuousBatcher(eng, kv, clock=FakeClock())
+    # prefill caches 3 tokens; decode write positions then run 3,4,5,6,7
+    b.run([Request(rid=0, arrival_s=0.0, prompt=[1, 2, 3], max_new_tokens=6)])
+    assert eng.decode_pages == [1, 2, 2, 2, 2]
+
+
+def test_oversize_request_refused_at_admission():
+    eng = FakeEngine()
+    kv = KVCacheConfig(max_slots=2, page_size=4, max_pages=2)  # max_ctx=8
+    b = ContinuousBatcher(eng, kv, clock=FakeClock())
+    with pytest.raises(ValueError, match="max_ctx"):
+        b.run([Request(rid=0, arrival_s=0.0, prompt=[1] * 6, max_new_tokens=4)])
+
+
+def test_arrivals_respected_and_summary_shape():
+    eng = FakeEngine()
+    kv = KVCacheConfig(max_slots=2, page_size=8, max_pages=2)
+    b = ContinuousBatcher(eng, kv, clock=FakeClock())
+    reqs = synthetic_requests(5, vocab_size=32, seed=3, rate_rps=200.0,
+                              prompt_len_range=(2, 6), max_new_tokens=3)
+    done = b.run(reqs)
+    assert len(done) == 5
+    for r in done:
+        assert r.prefill_start_t >= r.arrival_s  # never admitted early
+        assert r.first_token_t >= r.prefill_start_t
+        assert r.done_t >= r.first_token_t
+    s = summarize(done, wall_s=2.0, world_size=4)
+    assert s["requests"] == 5 and s["output_tokens"] == 15
+    assert s["tokens_per_s"] == pytest.approx(7.5)
+    assert s["tokens_per_s_per_chip"] == pytest.approx(7.5 / 4)
+    assert s["ttft_ms"]["p50"] <= s["ttft_ms"]["p99"]

@@ -1,0 +1,82 @@
+"""`search --objective serve` over the mock profiles: feasible winner with
+serve knobs serialized, GLS014 refusals for unsatisfiable latency/memory
+bounds, and the serve-mode lint round-trip."""
+
+import pytest
+
+from galvatron_tpu.analysis import strategy_lint as slint
+from galvatron_tpu.analysis.diagnostics import DiagnosticError
+from galvatron_tpu.config.strategy import HybridParallelConfig
+
+from tests.search_engine.test_search_engine import make_engine
+
+pytestmark = [pytest.mark.serve, pytest.mark.search_engine]
+
+
+def serve_engine(**kw):
+    kw.setdefault("objective", "serve")
+    kw.setdefault("serve_max_concurrency", 8)
+    kw.setdefault("serve_page_size", 16)
+    return make_engine(**kw)
+
+
+def test_serve_objective_picks_feasible_winner(tmp_path):
+    eng = serve_engine(mem_gb=16.0)
+    best = eng.serve_optimization()
+    sv = best["serve"]
+    assert best["pp"] == 1 and len(best["strategies"]) == 8
+    for s in best["strategies"]:
+        assert s[0] == 1 and s[3].get("cp", 1) == 1 and not s[3].get("sp", 0)
+    assert sv["tokens_per_s_per_chip"] > 0
+    assert sv["ttft_ms"] == pytest.approx(sv["prefill_ms"] + sv["decode_ms"])
+    assert sv["tpot_ms"] == pytest.approx(sv["decode_ms"])
+    assert sv["memory_mb"] <= 16.0 * 1024
+    # ctx rounds up to whole pages of the profile's seq_len
+    assert sv["max_ctx"] % 16 == 0 and sv["max_ctx"] >= 2048
+    # the winner serializes WITH the serve knobs and round-trips serve lint
+    path = eng.save_results(best, str(tmp_path / "serve.json"))
+    cfg = HybridParallelConfig.from_json(path, world_size=8)
+    assert cfg.serve_max_concurrency == 8 and cfg.serve_page_size == 16
+    report = slint.lint_strategy_file(path, world_size=8, mode="serve")
+    assert report.ok, report.render()
+
+
+def test_serve_objective_latency_bound_steers_choice():
+    """A binding TPOT bound must never produce a winner slower than the
+    unbounded one, and the bound actually holds."""
+    free = serve_engine(mem_gb=16.0).serve_optimization()
+    bound = free["serve"]["tpot_ms"] * 1.5
+    held = serve_engine(mem_gb=16.0, p99_tpot_ms=bound).serve_optimization()
+    assert held["serve"]["tpot_ms"] <= bound
+
+
+def test_serve_objective_refuses_unsatisfiable_tpot():
+    eng = serve_engine(mem_gb=16.0, p99_tpot_ms=1e-4)
+    with pytest.raises(DiagnosticError, match="GLS014") as ei:
+        eng.serve_optimization()
+    # the refusal carries nearest-miss detail, not just the code
+    assert "TPOT" in str(ei.value)
+
+
+def test_serve_objective_refuses_unsatisfiable_memory():
+    eng = serve_engine(mem_gb=0.05)
+    with pytest.raises(DiagnosticError, match="GLS014"):
+        eng.serve_optimization()
+
+
+def test_serve_objective_honors_ttft_bound():
+    free = serve_engine(mem_gb=16.0).serve_optimization()
+    with pytest.raises(DiagnosticError, match="GLS014"):
+        serve_engine(mem_gb=16.0,
+                     p99_ttft_ms=free["serve"]["ttft_ms"] * 1e-6
+                     ).serve_optimization()
+
+
+def test_train_objective_result_has_no_serve_knobs(tmp_path):
+    """`--objective train` (the default) must not stamp serve knobs into
+    the emitted config."""
+    eng = make_engine(mem_gb=16.0)
+    best = eng.parallelism_optimization()
+    path = eng.save_results(best, str(tmp_path / "train.json"))
+    cfg = HybridParallelConfig.from_json(path, world_size=8)
+    assert cfg.serve_max_concurrency == 0 and cfg.serve_page_size == 0

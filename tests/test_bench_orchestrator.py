@@ -73,12 +73,17 @@ def test_mfu_regression_gate_exit_codes(tmp_path):
                            "overlap": {"step_ms": 9.0}},
             "quant_comm": {"fp32": {"step_ms": 20.0},
                            "int8": {"step_ms": 22.0},
-                           "loss_delta_int8": 5e-05}}}}
+                           "loss_delta_int8": 5e-05},
+            "serve": {"gspmd": {"tokens_per_s_per_chip": 60.0},
+                      "searched": {"tokens_per_s_per_chip": 64.0,
+                                   "decode_step_ms": 2.0,
+                                   "ttft_ms_p99": 240.0}}}}}
     empty_round = {"n": 4, "parsed": None}  # wedged round: tolerated, skipped
     (tmp_path / "BENCH_r03.json").write_text(json.dumps(baseline))
     (tmp_path / "BENCH_r04.json").write_text(json.dumps(empty_round))
 
-    def run_gate(mfu, gate="1", overlap_step_ms=9.0, quant_step_ms=22.0):
+    def run_gate(mfu, gate="1", overlap_step_ms=9.0, quant_step_ms=22.0,
+                 serve_tps=64.0, serve_step_ms=2.0):
         fake = tmp_path / "fake.json"
         fake.write_text(json.dumps({"results": {
             "train_step": {"mfu": mfu, "tokens_per_sec_per_chip": 30000.0},
@@ -86,7 +91,11 @@ def test_mfu_regression_gate_exit_codes(tmp_path):
                            "overlap": {"step_ms": overlap_step_ms}},
             "quant_comm": {"fp32": {"step_ms": 20.0},
                            "int8": {"step_ms": quant_step_ms},
-                           "loss_delta_int8": 5e-05}}}))
+                           "loss_delta_int8": 5e-05},
+            "serve": {"gspmd": {"tokens_per_s_per_chip": 60.0},
+                      "searched": {"tokens_per_s_per_chip": serve_tps,
+                                   "decode_step_ms": serve_step_ms,
+                                   "ttft_ms_p99": 240.0}}}}))
         env = dict(os.environ,
                    GALVATRON_BENCH_FAKE_RESULTS=str(fake),
                    GALVATRON_BENCH_GATE=gate,
@@ -109,6 +118,14 @@ def test_mfu_regression_gate_exit_codes(tmp_path):
     p = run_gate(0.4, quant_step_ms=30.0)
     assert p.returncode == 1, p.stdout
     assert "quant_comm.int8.step_ms" in p.stdout
+    # the serving path is gated too (ISSUE 11): lost warm-path throughput or
+    # a slower decode step regresses even with training numbers healthy
+    p = run_gate(0.4, serve_tps=40.0)
+    assert p.returncode == 1, p.stdout
+    assert "serve.searched.tokens_per_s_per_chip" in p.stdout
+    p = run_gate(0.4, serve_step_ms=3.0)
+    assert p.returncode == 1, p.stdout
+    assert "serve.searched.decode_step_ms" in p.stdout
     p = run_gate(0.2, gate="")  # gate off: wedge-proofing contract holds
     assert p.returncode == 0 and "MFU-REGRESSION" not in p.stdout
     # no usable baseline at all: tolerated
