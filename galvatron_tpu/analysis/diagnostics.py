@@ -45,6 +45,7 @@ CODES: Dict[str, Tuple[str, str]] = {
     "GLS012": (ERROR, "config unsupported by the manual shard_map TP path"),
     "GLS013": (ERROR, "unsupported comm-precision (quantized collectives) configuration"),
     "GLS014": (ERROR, "serve-infeasible configuration (latency bound, KV budget, or layout)"),
+    "GLS015": (ERROR, "serve world infeasible after mesh degradation"),
     # ---- strategy linter (GLS1xx cost-model-backed warnings) ----
     "GLS101": (WARNING, "estimated per-device memory exceeds the HBM budget"),
     "GLS102": (WARNING, "expensive cross-layer redistribution between adjacent layers"),

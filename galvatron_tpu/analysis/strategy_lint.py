@@ -508,6 +508,13 @@ def lint_hp(
             "train mode: only the serve engine allocates a KV cache",
             key="serve_max_concurrency",
         ))
+    if mode == "train" and (hp.serve_p99_ttft_ms or hp.serve_max_pending):
+        report.add(D.make(
+            "GLS103", "serve_p99_ttft_ms/serve_max_pending are inert in "
+            "train mode: admission control and overload shedding live in "
+            "the serve batcher, not the training loop",
+            key="serve_p99_ttft_ms",
+        ))
     if file:
         report.diagnostics = [
             D.Diagnostic(**{**d.__dict__, "file": d.file or file})

@@ -411,6 +411,55 @@ def _add_serve_args(p: argparse.ArgumentParser):
                    help="write serve_request/decode_batch events to this "
                         "JSONL (analyze with `cli report`)")
     g.add_argument("--telemetry_buffer", type=int, default=1024)
+    r = p.add_argument_group("serving resilience")
+    # admission control + overload shedding (serve/engine.ContinuousBatcher)
+    r.add_argument("--p99_ttft_ms", type=float, default=0.0,
+                   help="shed (retryable) any pending request whose "
+                        "predicted TTFT — waited + queue depth x learned "
+                        "median prefill/tick cost — exceeds this bound "
+                        "(0 = admit everything; defaults to the strategy "
+                        "JSON's serve_p99_ttft_ms when set)")
+    r.add_argument("--max_pending", type=int, default=0,
+                   help="bound on the arrived-but-unadmitted queue; "
+                        "overflow sheds retryable from the newest arrivals "
+                        "(0 = unbounded; defaults to the strategy JSON's "
+                        "serve_max_pending when set)")
+    r.add_argument("--request_timeout_s", type=float, default=0.0,
+                   help="per-request TTFT deadline from arrival; a pending "
+                        "request past it sheds retryable (0 = none)")
+    r.add_argument("--shed_min_samples", type=int, default=3,
+                   help="prefills AND decode ticks observed before the "
+                        "predicted-TTFT shedder arms (compile warmup never "
+                        "sheds)")
+    # serve watchdog + degraded-mesh migration: the serving twins of the
+    # train-mode flags of the same names (runtime/health.py, runtime/elastic)
+    r.add_argument("--watchdog", type=float, default=0.0,
+                   help="arm the serve watchdog with this additive floor in "
+                        "seconds (0 = off): a prefill/decode tick making no "
+                        "progress for watchdog_factor * median(tick time) + "
+                        "floor seconds first drains-and-retries, then "
+                        "gracefully drains the batcher and exits 3")
+    r.add_argument("--watchdog_factor", type=float, default=4.0,
+                   help="k in the learned watchdog deadline "
+                        "k * median(tick time) + --watchdog floor")
+    r.add_argument("--watchdog_startup_s", type=float, default=600.0,
+                   help="watchdog deadline before enough ticks have run to "
+                        "learn one (first-bucket compiles take minutes)")
+    r.add_argument("--mesh_probe_interval", type=float, default=0.0,
+                   help="seconds between mesh-health probes between ticks "
+                        "(0 = off)")
+    r.add_argument("--migrate_on_degrade", type=int, default=0,
+                   help="on a degraded mesh verdict, re-search a serve "
+                        "strategy for the surviving world, relayout params "
+                        "in memory, rebuild the KV cache, and journal-replay "
+                        "in-flight requests instead of exiting; infeasible "
+                        "worlds refuse with GLS015 (exit 2)")
+    r.add_argument("--elastic_strategy", type=str, default=None,
+                   help="replacement serve strategy JSON for the surviving "
+                        "mesh (skips the degraded-world re-search)")
+    r.add_argument("--elastic_memory_gb", type=float, default=None,
+                   help="HBM budget per chip for the degraded-world serve "
+                        "re-search (default %.0f GB)" % 16.0)
 
 
 def build_parser(mode: str, extra_args_provider: Optional[Callable] = None) -> argparse.ArgumentParser:

@@ -80,6 +80,7 @@ SCALAR_KEYS = (
     "pp_deg", "global_bsz", "chunks", "pp_division", "pipeline_type",
     "default_dp_type", "vtp", "vsp", "vcp", "embed_sdp", "cp_mode",
     "comm_quant_block", "serve_max_concurrency", "serve_page_size",
+    "serve_p99_ttft_ms", "serve_max_pending",
 )
 KNOWN_STRATEGY_KEYS = frozenset(PER_LAYER_KEYS + PER_LAYER_STR_KEYS + SCALAR_KEYS)
 REQUIRED_STRATEGY_KEYS = ("pp_deg", "tp_sizes_enc", "dp_types_enc")
@@ -150,13 +151,20 @@ def schema_diagnostics(cfg: dict) -> list:
             "GLS005", "comm_quant_block must be a positive int, got %r" % (cqb,),
             key="comm_quant_block",
         ))
-    for k in ("serve_max_concurrency", "serve_page_size"):
+    for k in ("serve_max_concurrency", "serve_page_size", "serve_max_pending"):
         sv = cfg.get(k)
         if sv is not None and (not isinstance(sv, int) or sv < 0):
             out.append(D.make(
                 "GLS005", "%s must be a non-negative int, got %r" % (k, sv),
                 key=k,
             ))
+    ttft = cfg.get("serve_p99_ttft_ms")
+    if ttft is not None and (not isinstance(ttft, (int, float))
+                             or isinstance(ttft, bool) or ttft < 0):
+        out.append(D.make(
+            "GLS005", "serve_p99_ttft_ms must be a non-negative number, "
+            "got %r" % (ttft,), key="serve_p99_ttft_ms",
+        ))
     for k, lo in (("tp_sizes_enc", 1), ("cp_sizes_enc", 1)):
         for i, v in enumerate(arrays.get(k, [])):
             if v < lo:
@@ -322,6 +330,12 @@ class HybridParallelConfig:
     # train mode these knobs are inert (GLS103).
     serve_max_concurrency: int = 0
     serve_page_size: int = 0
+    # Shedding knobs (serve/engine.ContinuousBatcher admission control): the
+    # p99 TTFT bound the predicted-TTFT shedder enforces and the pending-
+    # queue depth bound. 0 = unset; like the geometry knobs, serialized only
+    # when set and inert (GLS103) in train mode.
+    serve_p99_ttft_ms: float = 0.0
+    serve_max_pending: int = 0
 
     def __post_init__(self):
         if self.pp_division is None:
@@ -386,13 +400,20 @@ class HybridParallelConfig:
                 "GLS005", "comm_quant_block must be a positive int, got %r"
                 % (self.comm_quant_block,), key="comm_quant_block",
             ))
-        for k in ("serve_max_concurrency", "serve_page_size"):
+        for k in ("serve_max_concurrency", "serve_page_size", "serve_max_pending"):
             sv = getattr(self, k)
             if not isinstance(sv, int) or sv < 0:
                 out.append(D.make(
                     "GLS005", "%s must be a non-negative int, got %r" % (k, sv),
                     key=k,
                 ))
+        if (not isinstance(self.serve_p99_ttft_ms, (int, float))
+                or isinstance(self.serve_p99_ttft_ms, bool)
+                or self.serve_p99_ttft_ms < 0):
+            out.append(D.make(
+                "GLS005", "serve_p99_ttft_ms must be a non-negative number, "
+                "got %r" % (self.serve_p99_ttft_ms,), key="serve_p99_ttft_ms",
+            ))
         if self.pp < 1 or self.world_size % self.pp != 0:
             out.append(D.make(
                 "GLS002", "world_size %d not divisible by pp %d"
@@ -645,6 +666,8 @@ class HybridParallelConfig:
             comm_quant_block=cfg.get("comm_quant_block", 64),
             serve_max_concurrency=cfg.get("serve_max_concurrency", 0),
             serve_page_size=cfg.get("serve_page_size", 0),
+            serve_p99_ttft_ms=cfg.get("serve_p99_ttft_ms", 0.0),
+            serve_max_pending=cfg.get("serve_max_pending", 0),
         )
         kw.update(overrides)
         return cls(**kw)
@@ -677,7 +700,11 @@ class HybridParallelConfig:
         } | ({
             "serve_max_concurrency": self.serve_max_concurrency,
             "serve_page_size": self.serve_page_size,
-        } if self.serve_max_concurrency or self.serve_page_size else {})
+        } if self.serve_max_concurrency or self.serve_page_size else {}) | ({
+            "serve_p99_ttft_ms": self.serve_p99_ttft_ms,
+        } if self.serve_p99_ttft_ms else {}) | ({
+            "serve_max_pending": self.serve_max_pending,
+        } if self.serve_max_pending else {})
 
     def save(self, path: str):
         write_json_config(self.to_json_dict(), path)

@@ -19,7 +19,10 @@ produces the numbers the perf loop runs on:
   memory recorded by the ``compile`` event.
 - **serving rollup** — when the stream carries ``serve_request`` /
   ``decode_batch`` events (``cli serve --telemetry``): TTFT/TPOT
-  percentiles, decode-step occupancy, and output tokens/s.
+  percentiles, decode-step occupancy, and output tokens/s; plus the
+  resilience ledger from ``serve_shed`` / ``serve_drain`` /
+  ``serve_migrate`` — shed rate by reason, drain outcomes, and live
+  degraded-mesh migrations.
 
 Exit-code contract (shared with the GLS/GLC lint framework): 0 = analyzed
 clean, 1 = schema violations in the stream, 2 = usage/IO failure.
@@ -42,8 +45,10 @@ from galvatron_tpu.obs import telemetry as T
 TIMELINE_TYPES = (
     "compile", "checkpoint_save", "checkpoint_restore", "checkpoint_gc",
     "anomaly_skip", "rollback", "retry", "preemption", "watchdog", "elastic",
-    "trace", "eval",
+    "trace", "eval", "serve_drain", "serve_migrate",
 )
+# serve_shed is deliberately NOT on the timeline: a shedding server emits
+# one per rejected request, which under overload is most of the load
 
 # timeline rendering: the watchdog's stack dump and a migration's full
 # strategy JSON are post-mortem payloads, not one-line timeline material
@@ -87,9 +92,14 @@ def _percentile(vals: Sequence[float], q: float) -> Optional[float]:
 
 
 def _serving_section(
-    reqs: List[Dict[str, Any]], batches: List[Dict[str, Any]]
+    reqs: List[Dict[str, Any]],
+    batches: List[Dict[str, Any]],
+    sheds: List[Dict[str, Any]] = (),
+    drains: List[Dict[str, Any]] = (),
+    migrates: List[Dict[str, Any]] = (),
 ) -> Dict[str, Any]:
-    """Latency/throughput rollup of serve_request + decode_batch events."""
+    """Latency/throughput rollup of serve_request + decode_batch events,
+    plus the resilience ledger (serve_shed/serve_drain/serve_migrate)."""
     ttft = [e.get("ttft_ms") for e in reqs]
     tpot = [e.get("tpot_ms") for e in reqs]
     out_tokens = sum(e.get("output_len") or 0 for e in reqs)
@@ -97,6 +107,11 @@ def _serving_section(
     dones = [e.get("done_t") for e in reqs if e.get("done_t") is not None]
     span = (max(dones) - min(arrivals)) if arrivals and dones else None
     occ = [e["occupancy"] for e in batches if e.get("occupancy") is not None]
+    by_reason: Dict[str, int] = {}
+    for e in sheds:
+        r = e.get("reason") or "?"
+        by_reason[r] = by_reason.get(r, 0) + 1
+    offered = len(reqs) + len(sheds)
     return {
         "requests": len(reqs),
         "output_tokens": out_tokens,
@@ -108,6 +123,19 @@ def _serving_section(
         "decode_steps": len(batches),
         "median_step_ms": _median([e.get("step_ms") for e in batches]),
         "mean_occupancy": (statistics.fmean(occ) if occ else None),
+        "shed": len(sheds),
+        "shed_retryable": sum(1 for e in sheds if e.get("retryable")),
+        "shed_rate": (len(sheds) / offered) if offered else None,
+        "shed_by_reason": dict(sorted(by_reason.items())),
+        "drains": [
+            {k: e.get(k) for k in ("reason", "completed", "active_completed",
+                                   "active_shed", "pending_shed", "exit_code")
+             if e.get(k) is not None}
+            for e in drains
+        ],
+        "migrations": len(migrates),
+        "migrated_worlds": [
+            [e.get("from_world"), e.get("to_world")] for e in migrates],
     }
 
 
@@ -220,8 +248,12 @@ def analyze(
     }
     serve_reqs = by_type.get("serve_request", [])
     decode_batches = by_type.get("decode_batch", [])
-    if serve_reqs or decode_batches:
-        analysis["serving"] = _serving_section(serve_reqs, decode_batches)
+    sheds = by_type.get("serve_shed", [])
+    drains = by_type.get("serve_drain", [])
+    migrates = by_type.get("serve_migrate", [])
+    if serve_reqs or decode_batches or sheds or drains or migrates:
+        analysis["serving"] = _serving_section(
+            serve_reqs, decode_batches, sheds, drains, migrates)
     run_end = by_type.get("run_end")
     if run_end and run_end[-1].get("summary") is not None:
         analysis["summary"] = run_end[-1]["summary"]
@@ -323,6 +355,29 @@ def render(analysis: Dict[str, Any]) -> str:
             lines.append(
                 "  %s p50/p90/p99: %s / %s / %s"
                 % (name, _fmt(p["p50"]), _fmt(p["p90"]), _fmt(p["p99"]))
+            )
+        if sv.get("shed"):
+            reasons = " ".join(
+                "%s=%d" % (k, v) for k, v in sv["shed_by_reason"].items())
+            lines.append(
+                "  shed: %s (%s retryable, rate %s) %s"
+                % (_fmt(sv["shed"]), _fmt(sv["shed_retryable"]),
+                   _fmt(sv["shed_rate"]), reasons)
+            )
+        for d in sv.get("drains") or ():
+            lines.append(
+                "  drain %s: completed %s, active completed %s, shed "
+                "%s active + %s pending"
+                % (_fmt(d.get("reason")), _fmt(d.get("completed")),
+                   _fmt(d.get("active_completed")), _fmt(d.get("active_shed")),
+                   _fmt(d.get("pending_shed")))
+            )
+        if sv.get("migrations"):
+            lines.append(
+                "  migrations: %s (%s)"
+                % (_fmt(sv["migrations"]),
+                   ", ".join("world %s->%s" % (a, b)
+                             for a, b in sv["migrated_worlds"]))
             )
     if analysis["timeline"]:
         lines.append("")

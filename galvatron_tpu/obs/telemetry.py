@@ -146,6 +146,31 @@ EVENT_SCHEMAS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
         ("step",),
         ("occupancy", "slots", "step_ms", "bucket_pages", "tokens"),
     ),
+    # one per shed/failed request (admission control + overload shedding):
+    # reason is "oversize" | "deadline" | "predicted_ttft" | "queue_full" |
+    # "drain" | "prefill_error" | "decode_error" | "migrate_infeasible" |
+    # "migrate_prefill_error"; retryable is 0/1 (oversize is the only
+    # non-retryable rejection today)
+    "serve_shed": (
+        ("id", "reason"),
+        ("retryable", "prompt_len", "output_len", "waited_ms",
+         "predicted_ttft_ms", "queue_depth", "error"),
+    ),
+    # one per graceful drain (SIGTERM/SIGINT, watchdog escalation, or an
+    # explicit control-plane drain): how the in-flight + pending load was
+    # disposed of
+    "serve_drain": (
+        ("reason",),
+        ("completed", "active_completed", "active_shed", "pending_shed",
+         "shed", "exit_code"),
+    ),
+    # one per degraded-mesh serve migration: the world transition plus how
+    # many in-flight requests were journal-replayed vs shed
+    "serve_migrate": (
+        ("from_world", "to_world"),
+        ("replayed", "shed", "duration_ms", "reason", "from_strategy",
+         "to_strategy", "kv_slots", "kv_pages"),
+    ),
     # jax.profiler start/stop_trace bracketing (--xla_trace)
     "trace": (("action",), ("dir", "first_step", "last_step", "error")),
     "log": (("message",), ()),

@@ -616,3 +616,178 @@ def migrate(
         model=new_model, params=new_params, opt_state=new_opt,
         same_layout=same_layout, from_hp=old_hp, to_hp=target_hp,
     )
+
+
+# ------------------------------------------------- degraded-mesh serve path
+def search_surviving_serve_strategy(
+    model_cfg: Any,
+    live_world: int,
+    memory_budget_gb: float,
+    serve_max_concurrency: int,
+    serve_page_size: int,
+    p99_ttft_ms: float = 0.0,
+    p99_tpot_ms: float = 0.0,
+    model_type: str = "model",
+    config_dir: Optional[str] = None,
+    default_dp_type: str = "ddp",
+    logger=None,
+) -> HybridParallelConfig:
+    """Re-run ``search --objective serve`` for the surviving world: the same
+    decode-compatible enumeration + ServeTimeCostModel pricing the offline
+    serve search uses, fed profiled tables when available and the analytic
+    fallback otherwise. Concurrency and page size are pinned to the RUNNING
+    engine's values so in-flight journals stay replayable into the new
+    cache. Raises GLS015 when no strategy is feasible on what survived."""
+    from galvatron_tpu.search.engine import GalvatronSearchEngine, SearchArgs
+
+    heads = getattr(model_cfg, "num_heads", None) or 1
+    nkv = getattr(model_cfg, "num_kv_heads", None) or heads
+    num_layers = getattr(model_cfg, "num_layers", 1)
+    seq_len = getattr(model_cfg, "max_seq_len", 2048)
+    hidden = getattr(model_cfg, "hidden_size", 1024)
+    max_tp = 1
+    while max_tp * 2 <= min(heads, live_world) and heads % (max_tp * 2) == 0:
+        max_tp *= 2
+    args = SearchArgs(
+        memory_constraint=memory_budget_gb,
+        max_tp_deg=max_tp,
+        max_pp_deg=1,  # serve layouts are pp=1 by contract (GLS014)
+        default_dp_type=default_dp_type,
+        sp_space="tp",
+        objective="serve",
+        p99_ttft_ms=p99_ttft_ms,
+        p99_tpot_ms=p99_tpot_ms,
+        serve_max_concurrency=serve_max_concurrency,
+        serve_page_size=serve_page_size,
+        serve_kv_frac=nkv / heads,
+    )
+    engine = GalvatronSearchEngine(
+        args, live_world,
+        [{"hidden_size": hidden, "seq_len": seq_len, "layer_num": num_layers}],
+        config_dir=config_dir or "configs", model_name=model_type, logger=logger,
+    )
+    profiles = None
+    if config_dir:
+        profiles = _load_profiled_tables(model_cfg, model_type, config_dir, live_world)
+    if profiles is None:
+        synth = analytic_model_profiles(model_cfg, max_tp=live_world)
+        if synth is None:
+            raise D.DiagnosticError([D.make(
+                "GLS015", "cannot synthesize analytic cost tables for this "
+                "model config — no way to re-plan serving for the %d "
+                "surviving devices" % live_world,
+            )])
+        time_cfg, mem_cfg = synth
+        allreduce, p2p, overlap = analytic_hardware_profiles(live_world)
+    else:
+        time_cfg, mem_cfg, allreduce, p2p, overlap = profiles
+    engine.set_model_profiles(time_cfg, mem_cfg)
+    engine.set_hardware_profiles(allreduce, p2p, overlap)
+    engine.initialize_search_engine()
+    try:
+        result = engine.serve_optimization()
+    except D.DiagnosticError as e:
+        # the offline objective refuses with GLS014 ("this config cannot
+        # serve"); mid-flight the refusal is about the DEGRADED WORLD
+        raise D.DiagnosticError([D.make(
+            "GLS015", "serve world infeasible after degradation: no serving "
+            "strategy for the %d surviving devices (%s); drain and redeploy "
+            "on a healthy slice" % (
+                live_world,
+                "; ".join(d.message for d in e.diagnostics)[:400]),
+        )]) from e
+    return engine.result_to_config(result)
+
+
+def resolve_serve_migration_strategy(
+    args: Any,
+    model_cfg: Any,
+    live_world: int,
+    current_hp: HybridParallelConfig,
+    kv_cfg: Any = None,
+) -> Tuple[HybridParallelConfig, str]:
+    """Pick the target strategy for a LIVE degraded-mesh serve migration:
+    the operator-supplied ``--elastic_strategy`` JSON when given, otherwise
+    a fresh ``--objective serve`` search for `live_world`. Returns
+    (hp, action). Raises DiagnosticError (GLS015) when the surviving world
+    cannot serve; the serve CLI maps that to exit code 2."""
+    exec_kw = dict(
+        scan_layers=current_hp.scan_layers,
+        remat_policy=current_hp.remat_policy,
+        tp_comm_mode=current_hp.tp_comm_mode,
+        tp_comm_quant=current_hp.tp_comm_quant,
+        mixed_precision=current_hp.mixed_precision,
+    )
+    budget = getattr(args, "elastic_memory_gb", None) or DEFAULT_MEMORY_GB
+    concurrency = (getattr(kv_cfg, "max_slots", 0)
+                   or current_hp.serve_max_concurrency or 8)
+    page = (getattr(kv_cfg, "page_size", 0)
+            or current_hp.serve_page_size or 16)
+    strategy_file = getattr(args, "elastic_strategy", None)
+    if strategy_file:
+        hp = HybridParallelConfig.from_json(
+            strategy_file, world_size=live_world, **exec_kw)
+        action = "strategy_file"
+    else:
+        hp = search_surviving_serve_strategy(
+            model_cfg, live_world, budget,
+            serve_max_concurrency=concurrency, serve_page_size=page,
+            p99_ttft_ms=getattr(args, "p99_ttft_ms", 0.0) or 0.0,
+            p99_tpot_ms=getattr(args, "p99_tpot_ms", 0.0) or 0.0,
+            model_type=getattr(args, "model_type", "model"),
+            config_dir=getattr(args, "config_dir", None),
+            default_dp_type=current_hp.default_dp_type,
+        )
+        for k, v in exec_kw.items():
+            setattr(hp, k, v)
+        action = "search"
+    from galvatron_tpu.analysis import strategy_lint as _slint
+
+    report = _slint.lint_hp(hp, model_cfg=model_cfg, mode="serve")
+    if not report.ok:
+        raise D.DiagnosticError([D.make(
+            "GLS015", "serve world infeasible after degradation: the %s "
+            "strategy for %d devices fails the serve lint (%s)" % (
+                action, live_world,
+                "; ".join("%s: %s" % (d.code, d.message)
+                          for d in report.errors)[:400]),
+        )])
+    return hp, action
+
+
+def migrate_serve_params(
+    model: Any,
+    params: Any,
+    target_hp: HybridParallelConfig,
+    devices: Any = None,
+    build_model: Any = None,
+) -> Tuple[Any, Any, bool]:
+    """Params-only live relayout for a serve migration: the inference twin
+    of :func:`migrate` with no optimizer state and no trajectory checks
+    (serving has no training trajectory to fork — global_bsz is inert).
+    Returns (new_model, new_params, same_layout); the caller rebuilds the
+    ServeEngine (fresh KV cache in the new layout) and journal-replays the
+    in-flight requests (serve/engine.ContinuousBatcher.migrate_to)."""
+    import jax
+
+    from galvatron_tpu.runtime import checkpoint as ckpt
+    from galvatron_tpu.runtime.model_api import construct_hybrid_parallel_model
+
+    old_hp: HybridParallelConfig = model.hp
+    same_layout = ckpt._same_param_layout(old_hp, target_hp)
+    if not same_layout and model.init_fn is not None:
+        raise D.DiagnosticError([D.make(
+            "GLS015", "serve migration across pipeline layouts (pp %s -> pp "
+            "%s) is only supported for the generic transformer tree; this "
+            "family builds its own params" % (old_hp.pp, target_hp.pp),
+        )])
+    if build_model is not None:
+        new_model = build_model(model.cfg, target_hp, devices)
+    else:
+        new_model = construct_hybrid_parallel_model(model.cfg, target_hp, devices)
+    if same_layout:
+        new_params = jax.device_put(params, new_model.shardings())
+    else:
+        new_params = jax.device_put(
+            ckpt._relayout_tree(params, old_hp, target_hp), new_model.shardings())
+    return new_model, new_params, same_layout

@@ -22,4 +22,5 @@ from galvatron_tpu.serve.kv_cache import (  # noqa: F401
     kv_cache_specs,
     layer_kv_spec,
     length_bias,
+    request_fits,
 )

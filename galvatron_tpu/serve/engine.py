@@ -47,6 +47,7 @@ from galvatron_tpu.serve.kv_cache import (
     init_kv_cache,
     kv_cache_specs,
     length_bias,
+    request_fits,
     write_prompt_kv,
 )
 from galvatron_tpu.parallel import spec as S
@@ -310,16 +311,31 @@ class Request:
     arrival_s: float
     prompt: List[int]
     max_new_tokens: int
+    deadline_s: Optional[float] = None  # absolute TTFT deadline (batcher clock)
     # runtime bookkeeping (filled by the batcher)
     slot: Optional[int] = None
     output: List[int] = dataclasses.field(default_factory=list)
     prefill_start_t: Optional[float] = None
     first_token_t: Optional[float] = None
     done_t: Optional[float] = None
+    # terminal disposition: "pending" while live, then exactly one of
+    # "completed" | "shed" (retryable, never started or abandoned mid-decode)
+    # | "failed" (non-retryable, e.g. oversize for the cache geometry).
+    status: str = "pending"
+    finish_reason: Optional[str] = None
+    retryable: bool = False
 
     @property
     def prompt_len(self) -> int:
         return len(self.prompt)
+
+    @property
+    def journal(self) -> List[int]:
+        """The request's full token history — prompt plus every sampled
+        token. Pure token sequences are replayable by construction: the
+        exact cache state of an in-flight request is reproduced by greedy
+        re-prefill of ``journal[:-1]`` (see ContinuousBatcher.migrate_to)."""
+        return list(self.prompt) + list(self.output)
 
     def ttft_ms(self) -> Optional[float]:
         if self.first_token_t is None:
@@ -384,9 +400,29 @@ class ContinuousBatcher:
     - admission is strict FIFO in arrival order — a later request never
       occupies a slot while an earlier arrived one waits;
     - no slot leak: every admitted request frees its slot at completion, and
-      a slot is never doubly occupied;
+      a slot is never doubly occupied — including under exceptions in
+      prefill or decode;
     - bucket routing: each decode tick runs in the smallest page bucket
-      covering every active slot's next write position.
+      covering every active slot's next write position;
+    - no request ever raises out of the batcher: oversize prompts, blown
+      deadlines, and predicted-TTFT overload are structured rejections
+      (`Request.status`/`finish_reason`/`retryable`) collected in
+      ``self.shed``, not exceptions.
+
+    Admission control: ``p99_ttft_ms`` arms a cheap predicted-TTFT model —
+    time already waited plus queue position times the learned median prefill
+    and decode-tick costs — that sheds (retryable) any pending request which
+    cannot meet the bound. ``max_pending`` bounds the arrived-but-unadmitted
+    queue; overflow sheds from the tail (newest arrivals). Both engage only
+    after ``min_shed_samples`` prefills AND ticks have been observed, so
+    compile warmup never sheds.
+
+    Resilience: an optional ``watchdog`` (runtime/health.Watchdog) is armed
+    around every prefill and decode tick with learned deadlines; an optional
+    ``control`` callback is polled once per scheduler iteration and may
+    return a drain-reason string (e.g. ``"SIGTERM"``, ``"watchdog"``) to
+    stop admission and wind down, or trigger a live migration itself via
+    ``migrate_to`` and return None (the cli/serve resilience hook).
     """
 
     def __init__(
@@ -394,17 +430,35 @@ class ContinuousBatcher:
         engine,
         kv_cfg: KVCacheConfig,
         clock: Optional[Callable[[], float]] = None,
+        p99_ttft_ms: float = 0.0,
+        max_pending: int = 0,
+        request_timeout_s: float = 0.0,
+        min_shed_samples: int = 3,
+        watchdog=None,
+        control: Optional[Callable[["ContinuousBatcher"], Optional[str]]] = None,
     ):
         self.engine = engine
         self.kv_cfg = kv_cfg
         self._clock = clock if clock is not None else time.monotonic
         self._t0: Optional[float] = None
+        self.p99_ttft_ms = float(p99_ttft_ms)
+        self.max_pending = int(max_pending)
+        self.request_timeout_s = float(request_timeout_s)
+        self.min_shed_samples = int(min_shed_samples)
+        self.watchdog = watchdog
+        self.control = control
         # host-side per-slot state (device lengths are never read back)
         self.slot_req: List[Optional[Request]] = [None] * kv_cfg.max_slots
         self.slot_len = np.zeros((kv_cfg.max_slots,), np.int64)
         self.slot_tok = np.zeros((kv_cfg.max_slots,), np.int32)
         self.decode_steps = 0
         self.completed: List[Request] = []
+        self.shed: List[Request] = []
+        self.migrations = 0
+        self.drain_reason: Optional[str] = None
+        # learned cost medians feeding the predicted-TTFT shed model
+        self._prefill_ms: deque = deque(maxlen=64)
+        self._tick_ms: deque = deque(maxlen=64)
 
     def now(self) -> float:
         if self._t0 is None:
@@ -417,6 +471,84 @@ class ContinuousBatcher:
                 return i
         return None
 
+    def occupancy(self) -> int:
+        return sum(1 for r in self.slot_req if r is not None)
+
+    # ------------------------------------------------- rejection + shedding
+    def _reject(self, req: Request, reason: str, retryable: bool,
+                **extra) -> None:
+        """Terminal structured rejection: mark the request, collect it, and
+        emit a `serve_shed` event. Never touches slot state — callers free
+        any slot the request held BEFORE rejecting."""
+        req.status = "shed" if retryable else "failed"
+        req.finish_reason = reason
+        req.retryable = retryable
+        req.done_t = self.now()
+        req.slot = None
+        self.shed.append(req)
+        T.emit(
+            "serve_shed", id=req.rid, reason=reason,
+            retryable=int(retryable), prompt_len=req.prompt_len,
+            output_len=len(req.output) or None,
+            waited_ms=max(0.0, (self.now() - req.arrival_s) * 1000.0),
+            **extra,
+        )
+
+    @staticmethod
+    def _median(xs) -> float:
+        if not xs:
+            return 0.0
+        s = sorted(xs)
+        return float(s[len(s) // 2])
+
+    def predicted_ttft_ms(self, req: Request, queue_pos: int) -> float:
+        """Cheap TTFT forecast: time already waited + one prefill for this
+        request + (queue depth ahead) × (median prefill + median tick) —
+        every request ahead costs its own prefill and roughly one decode
+        tick before a slot frees."""
+        waited = max(0.0, (self.now() - req.arrival_s) * 1000.0)
+        mp = self._median(self._prefill_ms)
+        mt = self._median(self._tick_ms)
+        return waited + mp + queue_pos * (mp + mt)
+
+    def _shed_scan(self, pending: deque) -> None:
+        """Drop pending requests that cannot be served: blown per-request
+        deadlines, predicted-TTFT overload, and pending-queue overflow.
+        Rebuilds the deque preserving FIFO order of the survivors."""
+        if not pending:
+            return
+        now = self.now()
+        learned = (len(self._prefill_ms) >= self.min_shed_samples
+                   and len(self._tick_ms) >= self.min_shed_samples)
+        keep: List[Request] = []
+        arrived_kept = 0
+        for req in pending:
+            if req.arrival_s > now:
+                keep.append(req)
+                continue
+            deadline = req.deadline_s
+            if deadline is None and self.request_timeout_s > 0:
+                deadline = req.arrival_s + self.request_timeout_s
+            if deadline is not None and now > deadline:
+                self._reject(req, "deadline", retryable=True)
+                continue
+            if self.p99_ttft_ms > 0 and learned:
+                pred = self.predicted_ttft_ms(req, arrived_kept)
+                if pred > self.p99_ttft_ms:
+                    self._reject(req, "predicted_ttft", retryable=True,
+                                 predicted_ttft_ms=pred,
+                                 queue_depth=arrived_kept)
+                    continue
+            if self.max_pending > 0 and arrived_kept >= self.max_pending:
+                self._reject(req, "queue_full", retryable=True,
+                             queue_depth=arrived_kept)
+                continue
+            arrived_kept += 1
+            keep.append(req)
+        if len(keep) != len(pending):
+            pending.clear()
+            pending.extend(keep)
+
     def _admit(self, pending: deque) -> None:
         while pending:
             req = pending[0]
@@ -426,16 +558,31 @@ class ContinuousBatcher:
             if slot is None:
                 break
             pending.popleft()
-            if req.prompt_len + req.max_new_tokens > self.kv_cfg.max_ctx:
-                raise ValueError(
-                    "request %d needs %d tokens > max_ctx %d — infeasible for "
-                    "this cache geometry" % (
-                        req.rid, req.prompt_len + req.max_new_tokens,
-                        self.kv_cfg.max_ctx)
-                )
+            if not request_fits(self.kv_cfg, req.prompt_len, req.max_new_tokens):
+                # structured per-request refusal: the slot was never
+                # occupied, the loop continues with the next arrival
+                self._reject(req, "oversize", retryable=False)
+                continue
             req.slot = slot
             req.prefill_start_t = self.now()
-            tok, _ = self.engine.prefill(req.prompt, slot)
+            if self.watchdog is not None:
+                self.watchdog.arm(self.decode_steps, phase="prefill",
+                                  inflight=self.occupancy())
+            try:
+                tok, _ = self.engine.prefill(req.prompt, slot)
+            except Exception as e:
+                # slot never assigned (slot_req[slot] still None): contain
+                # the failure to this request and keep serving
+                if self.watchdog is not None:
+                    self.watchdog.progress()
+                self._reject(req, "prefill_error", retryable=True,
+                             error=repr(e)[:200])
+                continue
+            prefill_ms = (self.now() - req.prefill_start_t) * 1000.0
+            self._prefill_ms.append(prefill_ms)
+            if self.watchdog is not None:
+                self.watchdog.observe_step_time(prefill_ms)
+                self.watchdog.progress()
             req.first_token_t = self.now()
             req.output.append(tok)
             self.slot_req[slot] = req
@@ -447,6 +594,8 @@ class ContinuousBatcher:
         req = self.slot_req[slot]
         if req is not None and len(req.output) >= req.max_new_tokens:
             req.done_t = self.now()
+            req.status = "completed"
+            req.finish_reason = "completed"
             self.completed.append(req)
             self.slot_req[slot] = None
             T.emit(
@@ -463,12 +612,43 @@ class ContinuousBatcher:
         active_lens = [int(self.slot_len[i]) for i, r in enumerate(self.slot_req) if r is not None]
         return bucket_pages(max(active_lens), self.kv_cfg.page_size, self.kv_cfg.max_pages)
 
+    def _abandon_active(self, reason: str) -> int:
+        """Free every occupied slot, rejecting its request as retryable —
+        the containment path for engine-wide decode failures and hard
+        drains. Returns how many were abandoned."""
+        n = 0
+        for slot, req in enumerate(self.slot_req):
+            if req is None:
+                continue
+            self.slot_req[slot] = None
+            self.slot_len[slot] = 0
+            self.slot_tok[slot] = 0
+            self._reject(req, reason, retryable=True)
+            n += 1
+        return n
+
     def _decode_tick(self) -> None:
         active = np.array([r is not None for r in self.slot_req], bool)
         pages = self.decode_pages()
         t_start = self.now()
-        next_tok, _ = self.engine.decode_step(self.slot_tok, active, pages)
+        if self.watchdog is not None:
+            self.watchdog.arm(self.decode_steps, phase="decode",
+                              inflight=int(active.sum()))
+        try:
+            next_tok, _ = self.engine.decode_step(self.slot_tok, active, pages)
+        except Exception:
+            # an engine-wide failure, not a per-request one: free every
+            # slot (no leak), park the requests as retryable, and let the
+            # driver decide (migrate / exit) on the re-raised error
+            if self.watchdog is not None:
+                self.watchdog.progress()
+            self._abandon_active("decode_error")
+            raise
         step_ms = (self.now() - t_start) * 1000.0
+        self._tick_ms.append(step_ms)
+        if self.watchdog is not None:
+            self.watchdog.observe_step_time(step_ms)
+            self.watchdog.progress()
         self.decode_steps += 1
         n_active = int(active.sum())
         tokens = 0
@@ -488,20 +668,120 @@ class ContinuousBatcher:
             tokens=tokens,
         )
 
+    # --------------------------------------------------------------- drain
+    def drain(self, reason: str, pending: Optional[deque] = None,
+              finish_active: bool = True) -> Dict[str, int]:
+        """Graceful wind-down: stop admitting (every pending request sheds
+        retryable), complete in-flight decodes where possible (bounded by
+        the tokens they still owe), mark anything left retryable, and emit
+        one `serve_drain` event. Idempotent per run()."""
+        if self.watchdog is not None:
+            self.watchdog.disarm()
+        pending_shed = 0
+        if pending:
+            while pending:
+                self._reject(pending.popleft(), "drain", retryable=True)
+                pending_shed += 1
+        active_before = self.occupancy()
+        completed_before = len(self.completed)
+        if finish_active and active_before:
+            budget = sum(
+                r.max_new_tokens - len(r.output)
+                for r in self.slot_req if r is not None
+            ) + active_before
+            try:
+                while self.occupancy() and budget > 0:
+                    self._decode_tick()
+                    budget -= 1
+            except Exception:
+                pass  # _decode_tick already freed slots + parked retryable
+        active_shed = self._abandon_active("drain")
+        self.drain_reason = reason
+        T.emit(
+            "serve_drain", reason=reason,
+            completed=len(self.completed),
+            active_completed=len(self.completed) - completed_before,
+            active_shed=active_shed, pending_shed=pending_shed,
+            shed=len(self.shed),
+        )
+        return {
+            "reason": reason, "pending_shed": pending_shed,
+            "active_shed": active_shed,
+            "active_completed": len(self.completed) - completed_before,
+        }
+
+    # ----------------------------------------------------------- migration
+    def migrate_to(self, engine, kv_cfg: Optional[KVCacheConfig] = None) -> Dict[str, int]:
+        """Swap in a new engine (typically rebuilt on a degraded mesh with a
+        re-searched strategy) and re-prefill every in-flight request from
+        its token journal into the new KV cache.
+
+        Replay math: after k sampled tokens the old cache holds the K/V of
+        ``prompt + output[:-1]`` (the last sampled token has not been
+        embedded yet — it is the pending `slot_tok`). Greedy prefill of that
+        prefix therefore reproduces the exact cache state AND re-samples
+        ``output[-1]``; the re-sampled token is discarded and `slot_tok` is
+        restored, so the greedy continuation is identical to an
+        uninterrupted run. Requests that no longer fit the new cache
+        geometry shed retryable instead of raising."""
+        if self.watchdog is not None:
+            self.watchdog.disarm()
+        old_slots = [(r, int(self.slot_len[i]), int(self.slot_tok[i]))
+                     for i, r in enumerate(self.slot_req) if r is not None]
+        self.engine = engine
+        if kv_cfg is not None:
+            self.kv_cfg = kv_cfg
+        self.slot_req = [None] * self.kv_cfg.max_slots
+        self.slot_len = np.zeros((self.kv_cfg.max_slots,), np.int64)
+        self.slot_tok = np.zeros((self.kv_cfg.max_slots,), np.int32)
+        replayed = shed = 0
+        for req, _, last_tok in old_slots:
+            replay = req.journal[:-1]
+            slot = self._free_slot()
+            remaining = req.max_new_tokens - len(req.output) + 1
+            if slot is None or not request_fits(self.kv_cfg, len(replay), remaining):
+                self._reject(req, "migrate_infeasible", retryable=True)
+                shed += 1
+                continue
+            try:
+                self.engine.prefill(replay, slot)  # re-sampled token == last_tok (greedy); discarded
+            except Exception as e:
+                self._reject(req, "migrate_prefill_error", retryable=True,
+                             error=repr(e)[:200])
+                shed += 1
+                continue
+            req.slot = slot
+            self.slot_req[slot] = req
+            self.slot_len[slot] = len(replay)
+            self.slot_tok[slot] = last_tok
+            replayed += 1
+        self.migrations += 1
+        return {"replayed": replayed, "shed": shed}
+
     def run(self, requests: Sequence[Request]) -> List[Request]:
         """Drive the load to completion; returns the completed requests in
-        completion order."""
+        completion order. Shed/failed requests land in ``self.shed``."""
         pending = deque(sorted(requests, key=lambda r: (r.arrival_s, r.rid)))
         self.now()  # start the clock
-        while pending or any(r is not None for r in self.slot_req):
-            self._admit(pending)
-            if any(r is not None for r in self.slot_req):
-                self._decode_tick()
-            elif pending:
-                # idle: wait out the arrival gap (real clock) / spin (fake)
-                gap = pending[0].arrival_s - self.now()
-                if gap > 0 and self._clock is time.monotonic:
-                    time.sleep(min(gap, 0.05))
+        try:
+            while pending or any(r is not None for r in self.slot_req):
+                if self.control is not None:
+                    verdict = self.control(self)
+                    if verdict:
+                        self.drain(str(verdict), pending)
+                        break
+                self._shed_scan(pending)
+                self._admit(pending)
+                if any(r is not None for r in self.slot_req):
+                    self._decode_tick()
+                elif pending:
+                    # idle: wait out the arrival gap (real clock) / spin (fake)
+                    gap = pending[0].arrival_s - self.now()
+                    if gap > 0 and self._clock is time.monotonic:
+                        time.sleep(min(gap, 0.05))
+        finally:
+            if self.watchdog is not None:
+                self.watchdog.disarm()
         return self.completed
 
 
@@ -514,12 +794,22 @@ def percentile(values: Sequence[float], q: float) -> float:
     return xs[idx]
 
 
-def summarize(completed: Sequence[Request], wall_s: float, world_size: int = 1) -> Dict[str, Any]:
-    """TTFT/TPOT percentiles + throughput for a finished load."""
+def summarize(
+    completed: Sequence[Request], wall_s: float, world_size: int = 1,
+    shed: Sequence[Request] = (),
+) -> Dict[str, Any]:
+    """TTFT/TPOT percentiles + throughput for a finished load, plus the shed
+    ledger (count, retryable count, per-reason breakdown) when given."""
     ttfts = [r.ttft_ms() for r in completed if r.ttft_ms() is not None]
     tpots = [r.tpot_ms() for r in completed if r.tpot_ms() is not None]
     out_tokens = sum(len(r.output) for r in completed)
+    by_reason: Dict[str, int] = {}
+    for r in shed:
+        by_reason[r.finish_reason or "unknown"] = by_reason.get(r.finish_reason or "unknown", 0) + 1
     return {
+        "shed": len(shed),
+        "shed_retryable": sum(1 for r in shed if r.retryable),
+        "shed_by_reason": by_reason,
         "requests": len(completed),
         "output_tokens": out_tokens,
         "wall_s": wall_s,

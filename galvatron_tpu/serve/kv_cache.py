@@ -74,6 +74,14 @@ def bucket_pages(length: int, page_size: int, max_pages: int) -> int:
     return max(1, pages)
 
 
+def request_fits(kv_cfg: KVCacheConfig, prompt_len: int, max_new_tokens: int) -> bool:
+    """Admission/replay feasibility for this cache geometry: the prompt plus
+    every token the request may still generate must fit in max_ctx. Shared
+    by ContinuousBatcher._admit (fresh requests) and migrate_to (journal
+    re-prefill into a possibly smaller post-degradation cache)."""
+    return int(prompt_len) + int(max_new_tokens) <= kv_cfg.max_ctx
+
+
 def layer_kv_spec(
     hp: HybridParallelConfig,
     layer_idx: int,

@@ -117,6 +117,18 @@ def test_serve_knobs_warn_inert_in_train_mode():
     assert not lint("warn/gls103_serve_knobs.json").warnings
 
 
+def test_shed_knobs_warn_inert_in_train_mode():
+    """GLS103's shedding-knob variant: serve_p99_ttft_ms/serve_max_pending
+    in a TRAIN-consumed config warn — admission control and overload
+    shedding live in the serve batcher, not the training loop."""
+    report = lint("warn/gls103_shed_knobs.json", mode="train")
+    assert report.ok, report.render()
+    assert "GLS103" in {d.code for d in report.warnings}, report.render()
+    assert not lint("warn/gls103_shed_knobs.json").warnings
+    # in SERVE mode the knobs are live configuration, not a smell
+    assert not lint("warn/gls103_shed_knobs.json", mode="serve").warnings
+
+
 def test_ring_nonuniform_second_gls010_variant():
     report = lint("broken/gls010_ring_nonuniform.json")
     assert "GLS010" in report.codes() and not report.ok

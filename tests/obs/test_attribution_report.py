@@ -136,14 +136,21 @@ def test_report_analyze_golden_steady_state_and_divergence():
     # lifecycle timeline carries the anomaly/rollback/save/restore story
     types = [e["type"] for e in analysis["timeline"]]
     for t in ("anomaly_skip", "rollback", "checkpoint_save",
-              "checkpoint_restore", "checkpoint_gc", "retry", "trace"):
+              "checkpoint_restore", "checkpoint_gc", "retry", "trace",
+              "serve_migrate", "serve_drain"):
         assert t in types, types
+    assert "serve_shed" not in types  # per-request noise stays off the timeline
     assert analysis["anomalies"] == {"skipped": 1, "rollbacks": 1, "retries": 1}
+
+
+SERVE_TYPES = ("serve_request", "decode_batch", "serve_shed", "serve_drain",
+               "serve_migrate")
 
 
 def test_report_serving_section_from_golden():
     """The golden stream's serve_request/decode_batch events roll up into
-    the serving section: TTFT/TPOT percentiles, occupancy, tokens/s."""
+    the serving section: TTFT/TPOT percentiles, occupancy, tokens/s, plus
+    the resilience ledger (shed rate, drain outcomes, migrations)."""
     events, errors = T.read_events(GOLDEN)
     assert errors == []
     analysis = R.analyze(events)
@@ -156,11 +163,22 @@ def test_report_serving_section_from_golden():
     assert sv["decode_steps"] == 2
     assert sv["median_step_ms"] == pytest.approx(28.5)
     assert sv["mean_occupancy"] == pytest.approx((2 / 4 + 1 / 4) / 2)
+    # resilience ledger: one predicted-TTFT shed of 3 offered, one SIGTERM
+    # drain, one 8->4 migration
+    assert sv["shed"] == 1 and sv["shed_retryable"] == 1
+    assert sv["shed_rate"] == pytest.approx(1 / 3)
+    assert sv["shed_by_reason"] == {"predicted_ttft": 1}
+    assert sv["drains"] == [{
+        "reason": "SIGTERM", "completed": 2, "active_completed": 1,
+        "active_shed": 0, "pending_shed": 1, "exit_code": 0}]
+    assert sv["migrations"] == 1 and sv["migrated_worlds"] == [[8, 4]]
     text = R.render(analysis)
     assert "serving:" in text and "tpot_ms p50/p90/p99" in text
+    assert "shed: 1" in text and "predicted_ttft=1" in text
+    assert "drain SIGTERM" in text
+    assert "migrations: 1 (world 8->4)" in text
     # train-only streams carry no serving section
-    train_only = [e for e in events
-                  if e["type"] not in ("serve_request", "decode_batch")]
+    train_only = [e for e in events if e["type"] not in SERVE_TYPES]
     assert "serving" not in R.analyze(train_only)
 
 

@@ -23,6 +23,27 @@ Scenarios (``python -m tests.runtime.fault_injection --scenario ...``):
                    the run for --hang_s seconds; the watchdog (armed via
                    --watchdog_floor/--watchdog_factor) must fire, escalate,
                    emergency-save, and exit with WATCHDOG_EXIT_CODE (3)
+
+Serve scenarios (same entry point; they drive ``cli serve`` instead of the
+training loop and print ``SERVE=<json>`` for the subprocess tests):
+    serve                  plain synthetic load (reference; exit 0)
+    serve_hang             a decode tick stalls --hang_s seconds at call
+                           --hang_at; the serve watchdog fires, escalates,
+                           drains gracefully, exits WATCHDOG_EXIT_CODE (3)
+    serve_sigterm          SIGTERM at decode step --sigterm_at; the
+                           PreemptionHandler drain completes in-flight
+                           decodes, sheds pending retryable, exits 0
+    serve_device_loss      the mesh probe sees half the devices vanish at
+                           decode step --lose_at; the engine re-plans for
+                           the survivors, relayouts params in memory,
+                           journal-replays in-flight requests, exits 0
+    serve_migrate_infeasible  same loss with an impossible
+                           --elastic_memory_gb: the re-search refuses with
+                           GLS015 and the process exits 2 after draining
+    serve_overload         all requests arrive at t=0 against slow decode
+                           ticks (--tick_ms) with a --p99_ttft_ms bound:
+                           the predicted-TTFT model sheds the unservable
+                           tail retryably instead of serving it late
 """
 
 from __future__ import annotations
@@ -131,6 +152,48 @@ def hang_hooks(at_step: int, hang_s: float):
     return FaultHooks(wrap_step_fn=wrap)
 
 
+def slow_tick_hooks(tick_s: float):
+    """FaultHooks sleeping `tick_s` inside every wrapped step call — the
+    deterministic slow-decode simulation the overload scenario sheds
+    against (real tick times on a test CPU are too fast and too noisy to
+    overload reproducibly)."""
+    import time as _time
+
+    from galvatron_tpu.runtime.resilience import FaultHooks
+
+    def wrap(step_fn):
+        def wrapped(*a, **kw):
+            out = step_fn(*a, **kw)
+            _time.sleep(tick_s)
+            return out
+
+        return wrapped
+
+    return FaultHooks(wrap_step_fn=wrap)
+
+
+def device_loss_hooks(at_step: int, live: int):
+    """(FaultHooks, probe_devices_fn) simulating losing devices mid-serve:
+    from the `at_step`-th observed step on, the mesh probe sees only the
+    first `live` devices. The hook keys on the driver's step callback so
+    the loss lands at a deterministic point in the request stream."""
+    from galvatron_tpu.runtime.resilience import FaultHooks
+
+    state = {"lost": False}
+
+    def on_step(it: int):
+        if it >= at_step:
+            state["lost"] = True
+
+    def probe():
+        import jax
+
+        devs = jax.devices()
+        return devs[:live] if state["lost"] else devs
+
+    return FaultHooks(on_step=on_step), probe
+
+
 def sigusr1_hooks(at_step: int):
     """FaultHooks sending THIS process SIGUSR1 ONCE at a step boundary —
     the manual live-migration trigger (the driver re-plans for the live
@@ -235,10 +298,94 @@ def tiny_argv(train_iters: int, save=None, load=None, save_interval=0,
     return argv + list(extra)
 
 
+def tiny_serve_argv(num_requests: int, world: int, extra: Sequence[str] = ()):
+    """The serve-mode twin of tiny_argv: 1-layer llama, 2 decode slots,
+    short prompts, greedy decode."""
+    argv = [
+        "--model_type", "llama", "--set_model_config_manually", "1",
+        "--hidden_size", "32", "--num_attention_heads", "2", "--num_layers", "1",
+        "--vocab_size", "64", "--seq_length", "64", "--mixed_precision", "fp32",
+        "--world_size", str(world),
+        "--num_requests", str(num_requests), "--max_new_tokens", "4",
+        "--prompt_len_min", "4", "--prompt_len_max", "8",
+        "--serve_max_concurrency", "2", "--serve_page_size", "8",
+    ]
+    if world > 1:
+        argv += ["--global_tp_deg", "2"]  # tp2 x dp leaves a live sub-world
+    return argv + list(extra)
+
+
+def run_serve_scenario(a) -> int:
+    """Drive ``cli serve`` under the scenario's injected fault; prints
+    SERVE=<json> and mirrors cli.serve.main's exit-code contract (GLS2xx /
+    GLS015 -> 2, watchdog escalation -> WATCHDOG_EXIT_CODE)."""
+    from galvatron_tpu.analysis.diagnostics import DiagnosticError
+    from galvatron_tpu.cli.arguments import initialize_galvatron
+    from galvatron_tpu.cli.serve import serve
+
+    extra = []
+    if a.telemetry:
+        extra += ["--telemetry", a.telemetry]
+    if a.p99_ttft_ms:
+        extra += ["--p99_ttft_ms", str(a.p99_ttft_ms),
+                  "--shed_min_samples", "2"]
+    if a.scenario == "serve_hang":
+        extra += ["--watchdog", str(a.watchdog_floor or 0.5),
+                  "--watchdog_factor", str(a.watchdog_factor)]
+    if a.scenario in ("serve_device_loss", "serve_migrate_infeasible"):
+        extra += ["--mesh_probe_interval", "0.02", "--migrate_on_degrade", "1"]
+        if a.elastic_memory_gb:
+            extra += ["--elastic_memory_gb", str(a.elastic_memory_gb)]
+    args = initialize_galvatron(
+        mode="serve", argv=tiny_serve_argv(a.num_requests, a.world, extra))
+    if a.scenario == "serve_hang":
+        args.fault_hooks = hang_hooks(a.hang_at, a.hang_s)
+    elif a.scenario == "serve_sigterm":
+        args.fault_hooks = sigterm_hooks(a.sigterm_at)
+    elif a.scenario in ("serve_device_loss", "serve_migrate_infeasible"):
+        args.fault_hooks, args.probe_devices_fn = device_loss_hooks(
+            a.lose_at, a.live)
+    elif a.scenario == "serve_overload" and a.tick_ms:
+        args.fault_hooks = slow_tick_hooks(a.tick_ms / 1e3)
+    try:
+        summary = serve(args)
+    except DiagnosticError as e:
+        if any(d.code.startswith("GLS2") or d.code == "GLS015"
+               for d in e.diagnostics):
+            for d in e.diagnostics:
+                print(d.format(), file=sys.stderr)
+            return 2
+        raise
+    print("SERVE=" + json.dumps({
+        "offered": a.num_requests,
+        "requests": summary["requests"],
+        "shed": summary["shed"],
+        "shed_retryable": summary["shed_retryable"],
+        "shed_by_reason": summary["shed_by_reason"],
+        "migrations": summary["migrations"],
+        "drain": summary["drain"],
+        "interrupted": summary.get("interrupted"),
+        "decode_steps": summary["decode_steps"],
+        "tokens_per_s": summary["tokens_per_s"],
+        "ttft_p99_ms": summary["ttft_ms"]["p99"],
+    }))
+    if (summary.get("watchdog") or {}).get("escalated"):
+        from galvatron_tpu.runtime.health import WATCHDOG_EXIT_CODE
+
+        return WATCHDOG_EXIT_CODE
+    return 0
+
+
+SERVE_SCENARIOS = ("serve", "serve_hang", "serve_sigterm",
+                   "serve_device_loss", "serve_migrate_infeasible",
+                   "serve_overload")
+
+
 def main(argv=None):
     p = argparse.ArgumentParser("fault_injection")
     p.add_argument("--scenario", required=True,
-                   choices=("train", "resume", "kill_mid_save", "sigterm", "hang"))
+                   choices=("train", "resume", "kill_mid_save", "sigterm",
+                            "hang") + SERVE_SCENARIOS)
     p.add_argument("--save", default=None)
     p.add_argument("--load", default=None)
     p.add_argument("--iters", type=int, default=6)
@@ -257,6 +404,20 @@ def main(argv=None):
     p.add_argument("--world", type=int, default=1)
     p.add_argument("--elastic", default=None, choices=(None, "resume", "search"),
                    help="forwarded as --elastic for the resume scenario")
+    # serve-scenario knobs
+    p.add_argument("--num_requests", type=int, default=12)
+    p.add_argument("--telemetry", default=None,
+                   help="forwarded as --telemetry (serve scenarios)")
+    p.add_argument("--p99_ttft_ms", type=float, default=0.0,
+                   help="forwarded as --p99_ttft_ms (serve_overload)")
+    p.add_argument("--tick_ms", type=float, default=0.0,
+                   help="injected sleep per decode tick (serve_overload)")
+    p.add_argument("--lose_at", type=int, default=3,
+                   help="decode step at which the mesh probe loses devices")
+    p.add_argument("--live", type=int, default=2,
+                   help="devices surviving the loss")
+    p.add_argument("--elastic_memory_gb", type=float, default=0.0,
+                   help="forwarded for the infeasible-migration scenario")
     a = p.parse_args(argv)
 
     if a.devices > 1:
@@ -268,6 +429,9 @@ def main(argv=None):
 
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_disable_most_optimizations", True)
+
+    if a.scenario in SERVE_SCENARIOS:
+        return run_serve_scenario(a)
 
     from galvatron_tpu.cli.arguments import initialize_galvatron
     from galvatron_tpu.cli.train import train

@@ -194,3 +194,149 @@ def test_injected_hang_trips_watchdog_emergency_save_and_elastic_resume(tmp_path
         "--elastic", "resume",
     )
     np.testing.assert_array_equal(parse(resumed.stdout, "LOSSES"), ref_losses[k:])
+
+
+# ------------------------------------------------------- serving resilience
+def read_telemetry(path):
+    from galvatron_tpu.obs import telemetry as T
+
+    events, errors = T.read_events(str(path))
+    assert errors == [], errors
+    return events
+
+
+def assert_no_request_lost(sv, events):
+    """The zero-slot-leak ledger: every offered request either completed
+    (serve_request event) or was shed with a structured rejection
+    (serve_shed event) — nothing vanished, nothing raised out."""
+    assert sv["requests"] + sv["shed"] == sv["offered"], sv
+    done = [e for e in events if e["type"] == "serve_request"]
+    shed = [e for e in events if e["type"] == "serve_shed"]
+    assert len(done) == sv["requests"] and len(shed) == sv["shed"]
+
+
+def test_serve_sigterm_drains_cleanly(tmp_path):
+    """SIGTERM mid-serve: in-flight decodes complete, pending requests shed
+    retryable, one serve_drain event, exit code 0."""
+    tl = tmp_path / "serve.jsonl"
+    proc = run_scenario(
+        "--scenario", "serve_sigterm", "--num_requests", "10",
+        "--sigterm_at", "2", "--telemetry", str(tl),
+    )
+    sv = parse(proc.stdout, "SERVE")
+    assert sv["interrupted"] == "SIGTERM" and sv["drain"] == "SIGTERM"
+    assert sv["shed"] > 0 and sv["shed"] == sv["shed_retryable"]
+    assert set(sv["shed_by_reason"]) == {"drain"}
+    events = read_telemetry(tl)
+    assert_no_request_lost(sv, events)
+    [drain] = [e for e in events if e["type"] == "serve_drain"]
+    assert drain["reason"] == "SIGTERM"
+    assert drain["completed"] == sv["requests"]
+    # the drain finished the admitted decodes rather than abandoning them
+    assert drain.get("active_shed") in (None, 0)
+
+
+def test_serve_hang_trips_watchdog_drains_and_exits_3(tmp_path):
+    """A decode tick stalling far past the learned deadline: the serve
+    watchdog fires, escalates, the batcher drains gracefully (admitted
+    requests complete, pending shed retryable), and the process exits with
+    the distinct WATCHDOG_EXIT_CODE."""
+    from galvatron_tpu.runtime.health import WATCHDOG_EXIT_CODE
+
+    tl = tmp_path / "serve.jsonl"
+    proc = run_scenario(
+        "--scenario", "serve_hang", "--num_requests", "8",
+        "--hang_at", "3", "--hang_s", "6",
+        "--watchdog_floor", "0.5", "--watchdog_factor", "2",
+        "--telemetry", str(tl),
+        expect_rc=WATCHDOG_EXIT_CODE, timeout=900,
+    )
+    assert "watchdog fire" in proc.stdout
+    sv = parse(proc.stdout, "SERVE")
+    assert sv["interrupted"] == "watchdog" and sv["drain"] == "watchdog"
+    assert sv["requests"] > 0  # the stalled tick's requests still finished
+    assert sv["shed"] == sv["shed_retryable"] > 0
+    events = read_telemetry(tl)
+    assert_no_request_lost(sv, events)
+    [drain] = [e for e in events if e["type"] == "serve_drain"]
+    assert drain["reason"] == "watchdog"
+
+
+def test_serve_device_loss_migrates_and_completes_every_request(tmp_path):
+    """Half the mesh vanishes mid-serve: the engine re-plans for the
+    survivors, relayouts params in memory, journal-replays the in-flight
+    requests, and EVERY offered request completes — zero sheds, zero slot
+    leaks, serving demonstrably resumed after the migration."""
+    tl = tmp_path / "serve.jsonl"
+    proc = run_scenario(
+        "--scenario", "serve_device_loss", "--num_requests", "8",
+        "--world", "4", "--devices", "4", "--lose_at", "2", "--live", "2",
+        "--telemetry", str(tl),
+    )
+    sv = parse(proc.stdout, "SERVE")
+    assert sv["migrations"] == 1 and sv["drain"] is None
+    assert sv["requests"] == sv["offered"] and sv["shed"] == 0
+    assert sv["tokens_per_s"] > 0
+    events = read_telemetry(tl)
+    assert_no_request_lost(sv, events)
+    [mig] = [e for e in events if e["type"] == "serve_migrate"]
+    assert mig["from_world"] == 4 and mig["to_world"] == 2
+    assert mig["replayed"] >= 1 and mig["shed"] == 0
+    # tokens/s recovery: decode ticks keep landing AFTER the migration
+    post = [e for e in events
+            if e["type"] == "decode_batch" and e["seq"] > mig["seq"]]
+    assert len(post) >= 2
+    assert all(e["step_ms"] > 0 for e in post)
+
+
+def test_serve_migrate_infeasible_refuses_gls015_exit_2(tmp_path):
+    """Same device loss with an impossible re-search budget: the surviving
+    world cannot serve, so the engine drains (structured, retryable) and
+    exits 2 with a GLS015 diagnostic — the operator-input contract."""
+    tl = tmp_path / "serve.jsonl"
+    proc = run_scenario(
+        "--scenario", "serve_migrate_infeasible", "--num_requests", "8",
+        "--world", "4", "--devices", "4", "--lose_at", "2", "--live", "2",
+        "--elastic_memory_gb", "0.000001", "--telemetry", str(tl),
+        expect_rc=2,
+    )
+    assert "GLS015" in proc.stderr
+    events = read_telemetry(tl)
+    # the batcher's drain ledger plus the final exit-stamped event
+    drains = [e for e in events if e["type"] == "serve_drain"]
+    assert drains and all(e["reason"] == "migrate_infeasible" for e in drains)
+    assert drains[-1]["exit_code"] == 2
+    # every request is accounted for even on the refusal path
+    done = [e for e in events if e["type"] == "serve_request"]
+    shed = [e for e in events if e["type"] == "serve_shed"]
+    assert len(done) + len(shed) == 8
+    assert all(e["retryable"] for e in shed)
+
+
+def test_serve_overload_sheds_instead_of_blowing_p99(tmp_path):
+    """2x overload against slow decode ticks: without a bound every request
+    is served late; with --p99_ttft_ms the predicted-TTFT model sheds the
+    unservable tail retryably and the served p99 TTFT stays strictly below
+    the unbounded run's."""
+    base_tl, shed_tl = tmp_path / "base.jsonl", tmp_path / "shed.jsonl"
+    base = run_scenario(
+        "--scenario", "serve_overload", "--num_requests", "16",
+        "--tick_ms", "30", "--telemetry", str(base_tl),
+    )
+    sv_base = parse(base.stdout, "SERVE")
+    assert sv_base["shed"] == 0 and sv_base["requests"] == 16
+
+    proc = run_scenario(
+        "--scenario", "serve_overload", "--num_requests", "16",
+        "--tick_ms", "30", "--p99_ttft_ms", "1000", "--telemetry", str(shed_tl),
+    )
+    sv = parse(proc.stdout, "SERVE")
+    assert sv["shed"] > 0 and sv["shed"] == sv["shed_retryable"]
+    assert set(sv["shed_by_reason"]) == {"predicted_ttft"}
+    events = read_telemetry(shed_tl)
+    assert_no_request_lost(sv, events)
+    sheds = [e for e in events if e["type"] == "serve_shed"]
+    assert all(e["reason"] == "predicted_ttft" and
+               e["predicted_ttft_ms"] > 1000 for e in sheds)
+    # the point of shedding: the requests we DID serve met their latency
+    assert sv["ttft_p99_ms"] < sv_base["ttft_p99_ms"]

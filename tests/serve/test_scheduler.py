@@ -1,10 +1,13 @@
 """ContinuousBatcher invariants with a pure-host fake engine + fake clock
-(no jax compilation): FIFO admission, slot hygiene, bucket routing, and the
-oversize-request refusal."""
+(no jax compilation): FIFO admission, slot hygiene, bucket routing, the
+structured oversize rejection, overload shedding, exception containment
+(no slot leak under failing prefill/decode), drain, and journal-replay
+migration."""
 
 import numpy as np
 import pytest
 
+from galvatron_tpu.obs import telemetry as T
 from galvatron_tpu.serve.engine import (
     ContinuousBatcher,
     Request,
@@ -63,6 +66,7 @@ def test_fifo_admission_under_slot_pressure():
     assert len(done) == 6
     # prefill order == arrival (rid) order even though only 2 slots exist
     assert [p[0] for _, p in eng.prefills] == list(range(6))
+    assert all(r.status == "completed" for r in done)
 
 
 def test_no_slot_leak_or_double_occupancy():
@@ -93,12 +97,226 @@ def test_bucket_routing_tracks_active_write_positions():
     assert eng.decode_pages == [1, 2, 2, 2, 2]
 
 
-def test_oversize_request_refused_at_admission():
+def test_oversize_request_rejected_structured_not_raised():
+    """An oversize prompt is a per-request failure, not a run killer: the
+    request is marked failed/oversize (non-retryable), its slot is never
+    occupied, and the requests around it complete normally."""
     eng = FakeEngine()
     kv = KVCacheConfig(max_slots=2, page_size=4, max_pages=2)  # max_ctx=8
+    sink = T.MemorySink()
+    T.install(sink)
+    try:
+        b = ContinuousBatcher(eng, kv, clock=FakeClock())
+        reqs = [
+            Request(rid=0, arrival_s=0.0, prompt=[1] * 3, max_new_tokens=4),
+            Request(rid=1, arrival_s=0.0, prompt=[1] * 6, max_new_tokens=4),
+            Request(rid=2, arrival_s=0.0, prompt=[2] * 3, max_new_tokens=4),
+        ]
+        done = b.run(reqs)
+    finally:
+        T.uninstall(sink)
+    assert sorted(r.rid for r in done) == [0, 2]
+    assert len(b.shed) == 1
+    bad = b.shed[0]
+    assert bad.rid == 1 and bad.status == "failed"
+    assert bad.finish_reason == "oversize" and not bad.retryable
+    assert bad.slot is None
+    assert all(r is None for r in b.slot_req)
+    sheds = [e for e in sink.events if e["type"] == "serve_shed"]
+    assert len(sheds) == 1 and sheds[0]["reason"] == "oversize"
+    assert sheds[0]["retryable"] == 0
+
+
+def test_no_slot_leak_when_prefill_raises():
+    """A prefill exception is contained to its request: marked shed
+    (retryable), slot never occupied, the rest of the load completes."""
+    eng = FakeEngine()
+    real_prefill = eng.prefill
+
+    def flaky_prefill(prompt, slot):
+        if prompt == [1] * 3:  # rid 1's identifying prompt
+            raise RuntimeError("injected prefill fault")
+        return real_prefill(prompt, slot)
+
+    eng.prefill = flaky_prefill
+    kv = KVCacheConfig(max_slots=2, page_size=8, max_pages=2)
     b = ContinuousBatcher(eng, kv, clock=FakeClock())
-    with pytest.raises(ValueError, match="max_ctx"):
-        b.run([Request(rid=0, arrival_s=0.0, prompt=[1] * 6, max_new_tokens=4)])
+    done = b.run(backlog(5))
+    assert sorted(r.rid for r in done) == [0, 2, 3, 4]
+    assert len(b.shed) == 1
+    assert b.shed[0].rid == 1
+    assert b.shed[0].status == "shed" and b.shed[0].retryable
+    assert b.shed[0].finish_reason == "prefill_error"
+    assert all(r is None for r in b.slot_req)
+
+
+def test_no_slot_leak_when_decode_raises():
+    """A decode exception is engine-wide: every slot is freed, every
+    in-flight request is parked retryable, and the error propagates so the
+    driver can migrate or exit — zero slot leaks either way."""
+    eng = FakeEngine()
+    calls = {"n": 0}
+    real_decode = eng.decode_step
+
+    def flaky_decode(tokens, active, pages):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise RuntimeError("injected decode fault")
+        return real_decode(tokens, active, pages)
+
+    eng.decode_step = flaky_decode
+    kv = KVCacheConfig(max_slots=3, page_size=8, max_pages=2)
+    b = ContinuousBatcher(eng, kv, clock=FakeClock())
+    with pytest.raises(RuntimeError, match="injected decode fault"):
+        b.run(backlog(3, new=6))
+    assert all(r is None for r in b.slot_req)
+    assert np.all(b.slot_len == 0) and np.all(b.slot_tok == 0)
+    assert len(b.shed) == 3
+    assert all(r.retryable and r.finish_reason == "decode_error"
+               for r in b.shed)
+
+
+def test_predicted_ttft_shedding_under_overload():
+    """With a p99 TTFT bound and a deep backlog, the predicted-TTFT model
+    sheds the tail retryably instead of serving it late; every request is
+    accounted for (completed + shed == offered) and slots stay clean."""
+    eng = FakeEngine()
+    kv = KVCacheConfig(max_slots=1, page_size=8, max_pages=2)
+    # FakeClock(dt=0.01): each clock read advances 10ms, so prefills and
+    # ticks "cost" tens of ms while the bound admits only the queue head
+    b = ContinuousBatcher(eng, kv, clock=FakeClock(dt=0.01),
+                          p99_ttft_ms=300.0, min_shed_samples=2)
+    done = b.run(backlog(12, new=4))
+    assert len(done) + len(b.shed) == 12
+    assert len(b.shed) > 0
+    assert all(r.retryable and r.finish_reason == "predicted_ttft"
+               for r in b.shed)
+    assert all(r is None for r in b.slot_req)
+    # the survivors met the bound's prediction at admission: they were
+    # admitted FIFO, so the shed set is a suffix of the arrival order
+    assert min(r.rid for r in b.shed) > max(
+        r.rid for r in done if r.rid not in {s.rid for s in b.shed})
+
+
+def test_warmup_never_sheds():
+    """Before min_shed_samples prefills+ticks are observed the predicted-
+    TTFT shedder stays disarmed — compile warmup cannot shed."""
+    eng = FakeEngine()
+    kv = KVCacheConfig(max_slots=1, page_size=8, max_pages=2)
+    b = ContinuousBatcher(eng, kv, clock=FakeClock(dt=0.01),
+                          p99_ttft_ms=1.0, min_shed_samples=10 ** 6)
+    done = b.run(backlog(4, new=3))
+    assert len(done) == 4 and not b.shed
+
+
+def test_bounded_pending_queue_sheds_overflow():
+    eng = FakeEngine()
+    kv = KVCacheConfig(max_slots=1, page_size=8, max_pages=2)
+    b = ContinuousBatcher(eng, kv, clock=FakeClock(), max_pending=2)
+    done = b.run(backlog(8, new=3))
+    assert len(done) + len(b.shed) == 8
+    assert len(b.shed) > 0
+    assert all(r.finish_reason == "queue_full" and r.retryable
+               for r in b.shed)
+
+
+def test_request_deadline_sheds():
+    eng = FakeEngine()
+    kv = KVCacheConfig(max_slots=1, page_size=8, max_pages=2)
+    b = ContinuousBatcher(eng, kv, clock=FakeClock(dt=0.01),
+                          request_timeout_s=0.2)
+    done = b.run(backlog(10, new=6))
+    assert len(done) + len(b.shed) == 10
+    assert len(b.shed) > 0
+    assert all(r.finish_reason == "deadline" and r.retryable for r in b.shed)
+
+
+def test_control_drain_completes_inflight_and_sheds_pending():
+    """A control verdict drains: in-flight decodes run to completion,
+    pending requests shed retryable, one serve_drain event is emitted."""
+    eng = FakeEngine()
+    kv = KVCacheConfig(max_slots=2, page_size=8, max_pages=2)
+    ticks = {"n": 0}
+
+    def control(b):
+        ticks["n"] += 1
+        return "SIGTERM" if ticks["n"] == 3 else None
+
+    sink = T.MemorySink()
+    T.install(sink)
+    try:
+        b = ContinuousBatcher(eng, kv, clock=FakeClock(), control=control)
+        done = b.run(backlog(8, new=5))
+    finally:
+        T.uninstall(sink)
+    assert b.drain_reason == "SIGTERM"
+    assert len(done) + len(b.shed) == 8
+    assert all(r is None for r in b.slot_req)
+    # the two in-flight at drain time completed their full decodes
+    assert all(len(r.output) == r.max_new_tokens for r in done)
+    assert all(r.finish_reason == "drain" and r.retryable for r in b.shed)
+    drains = [e for e in sink.events if e["type"] == "serve_drain"]
+    assert len(drains) == 1 and drains[0]["reason"] == "SIGTERM"
+    assert drains[0]["completed"] == len(done)
+    assert drains[0]["pending_shed"] == len(b.shed)
+
+
+def test_migrate_to_replays_journals_and_continues_identically():
+    """Mid-run migration to a fresh engine: in-flight journals re-prefill
+    (replay prompt = prompt + output[:-1], slot_tok restored) and the
+    continuation matches an uninterrupted run token-for-token."""
+    kv = KVCacheConfig(max_slots=2, page_size=8, max_pages=4)
+    # reference: uninterrupted run
+    ref = ContinuousBatcher(FakeEngine(), kv, clock=FakeClock())
+    ref_done = {r.rid: list(r.output) for r in ref.run(backlog(4, new=6))}
+
+    eng_a, eng_b = FakeEngine(), FakeEngine()
+    ticks = {"n": 0}
+
+    def control(b):
+        ticks["n"] += 1
+        if ticks["n"] == 4:
+            res = b.migrate_to(eng_b, kv)
+            assert res["replayed"] == 2 and res["shed"] == 0
+        return None
+
+    b = ContinuousBatcher(eng_a, kv, clock=FakeClock(), control=control)
+    done = {r.rid: list(r.output) for r in b.run(backlog(4, new=6))}
+    assert b.migrations == 1
+    assert done == ref_done
+    # the replay prefills hit the NEW engine with prompt + output[:-1]
+    for slot, replay in eng_b.prefills[:2]:
+        rid = replay[0]  # identifying prompts are [rid]*3
+        orig = [rid] * 3
+        assert replay[:3] == orig
+        assert replay[3:] == ref_done[rid][:len(replay) - 3]
+
+
+def test_migrate_to_sheds_requests_that_no_longer_fit():
+    """Shrinking the cache geometry mid-flight: journals that cannot fit
+    the new max_ctx shed retryable instead of raising."""
+    kv_big = KVCacheConfig(max_slots=2, page_size=8, max_pages=4)  # ctx 32
+    kv_small = KVCacheConfig(max_slots=2, page_size=8, max_pages=1)  # ctx 8
+    eng_b = FakeEngine()
+    ticks = {"n": 0}
+    res = {}
+
+    def control(b):
+        ticks["n"] += 1
+        if ticks["n"] == 3:
+            res.update(b.migrate_to(eng_b, kv_small))
+        return None
+
+    b = ContinuousBatcher(FakeEngine(), kv_big, clock=FakeClock(),
+                          control=control)
+    # prompt 10 + 8 new = 18 > the shrunken ctx of 8: must shed on migrate
+    done = b.run([Request(rid=0, arrival_s=0.0, prompt=[3] * 10,
+                          max_new_tokens=8)])
+    assert res == {"replayed": 0, "shed": 1}
+    assert done == [] and len(b.shed) == 1
+    assert b.shed[0].finish_reason == "migrate_infeasible"
+    assert b.shed[0].retryable
+    assert all(r is None for r in b.slot_req)
 
 
 def test_arrivals_respected_and_summary_shape():
@@ -113,8 +331,9 @@ def test_arrivals_respected_and_summary_shape():
         assert r.prefill_start_t >= r.arrival_s  # never admitted early
         assert r.first_token_t >= r.prefill_start_t
         assert r.done_t >= r.first_token_t
-    s = summarize(done, wall_s=2.0, world_size=4)
+    s = summarize(done, wall_s=2.0, world_size=4, shed=b.shed)
     assert s["requests"] == 5 and s["output_tokens"] == 15
     assert s["tokens_per_s"] == pytest.approx(7.5)
     assert s["tokens_per_s_per_chip"] == pytest.approx(7.5 / 4)
     assert s["ttft_ms"]["p50"] <= s["ttft_ms"]["p99"]
+    assert s["shed"] == 0 and s["shed_by_reason"] == {}
