@@ -195,6 +195,12 @@ GATE_METRICS = (
     ("extra.serve.searched.tokens_per_s_per_chip", True),
     ("extra.serve.searched.decode_step_ms", False),
     ("extra.serve.searched.ttft_ms_p99", False),
+    # Silent-corruption sentinel (ISSUE 13): the gate pins all three
+    # sentinel modes' step time — digest must stay within its <= 2%
+    # budget and the vote's shard_map digest cannot silently bloat
+    ("extra.sdc_overhead.off.step_ms", False),
+    ("extra.sdc_overhead.digest.step_ms", False),
+    ("extra.sdc_overhead.vote.step_ms", False),
 )
 
 

@@ -15,7 +15,12 @@ Checks:
 - manifests carry provenance (GLS213 when missing — resumable only on the
   identical mesh), whose strategy JSON lints clean against its own recorded
   world size (the GLS0xx pipeline) and whose mesh/device bookkeeping is
-  self-consistent (GLS212).
+  self-consistent (GLS212);
+- with ``--deep`` (the one opt-out of the host-only contract), each step's
+  arrays are actually restored host-side and their layout-invariant
+  integrity fold (runtime/sdc.py) recomputed against the manifest's
+  recorded one (GLS214) — catches bit rot *between* save and resume, which
+  the torn-write sha256 only catches at restore time.
 """
 
 from __future__ import annotations
@@ -66,8 +71,54 @@ def _provenance_diagnostics(step: int, prov: Dict[str, Any]) -> List[D.Diagnosti
     return out
 
 
-def audit_checkpoint_dir(path: str) -> D.DiagnosticReport:
-    """Audit one checkpoint directory."""
+def _deep_item_diagnostics(path: str, step: int, items: Dict[str, Any], add) -> None:
+    """``--deep``: restore each array item host-side and recompute the
+    layout-invariant integrity fold against the manifest's record. A
+    mismatch is GLS214 — the bytes changed between save and now (bit rot,
+    a partial overwrite, tampering), which the restore-time sha256 would
+    also catch but only once a resume already bet on the directory."""
+    import jax
+    import orbax.checkpoint as ocp
+
+    from galvatron_tpu.runtime import checkpoint as ck
+    from galvatron_tpu.runtime import sdc
+
+    with ck._manager(path) as mgr:
+        try:
+            metas = dict(mgr.item_metadata(step).items())
+        except Exception as e:
+            add("GLS212", "step %d: cannot enumerate item metadata for the "
+                "deep audit (%s)" % (step, e))
+            return
+        for name, rec in sorted(items.items()):
+            if name == "train_meta" or name not in metas:
+                continue
+            want = rec.get("fold")
+            if want is None:
+                add("GLS213", "step %d item %r predates the integrity fold; "
+                    "the deep audit cannot verify its values" % (step, name))
+                continue
+            try:
+                abstract = jax.tree.map(
+                    lambda m: jax.ShapeDtypeStruct(m.shape, m.dtype),
+                    metas[name])
+                restored = mgr.restore(step, args=ocp.args.Composite(
+                    **{name: ocp.args.StandardRestore(abstract)}))[name]
+            except Exception as e:
+                add("GLS212", "step %d item %r failed to restore for the "
+                    "deep audit (%s)" % (step, name, e))
+                continue
+            got = sdc.host_tree_fold(restored)
+            if got != int(want) & 0xFFFFFFFF:
+                add("GLS214", "step %d item %r: recomputed integrity fold "
+                    "0x%08x != manifest 0x%08x — the checkpoint bytes "
+                    "changed since save" % (step, name, got, int(want)))
+
+
+def audit_checkpoint_dir(path: str, deep: bool = False) -> D.DiagnosticReport:
+    """Audit one checkpoint directory. `deep` additionally restores every
+    array item and verifies its integrity fold (GLS214) — no longer
+    host-metadata-only, so it costs a full read of the checkpoint."""
     from galvatron_tpu.runtime import checkpoint as ck
 
     report = D.DiagnosticReport()
@@ -121,6 +172,8 @@ def audit_checkpoint_dir(path: str) -> D.DiagnosticReport:
                 if missing:
                     add("GLS212", "step %d item %r record lacks %s"
                         % (step, name, ", ".join(missing)))
+            if deep:
+                _deep_item_diagnostics(path, step, items, add)
         prov = manifest.get("provenance")
         if prov is None:
             add("GLS213", "step %d manifest has no provenance (resumable "

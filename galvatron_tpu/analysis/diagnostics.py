@@ -46,6 +46,7 @@ CODES: Dict[str, Tuple[str, str]] = {
     "GLS013": (ERROR, "unsupported comm-precision (quantized collectives) configuration"),
     "GLS014": (ERROR, "serve-infeasible configuration (latency bound, KV budget, or layout)"),
     "GLS015": (ERROR, "serve world infeasible after mesh degradation"),
+    "GLS016": (ERROR, "state motion changed the layout-invariant integrity digest"),
     # ---- strategy linter (GLS1xx cost-model-backed warnings) ----
     "GLS101": (WARNING, "estimated per-device memory exceeds the HBM budget"),
     "GLS102": (WARNING, "expensive cross-layer redistribution between adjacent layers"),
@@ -63,6 +64,7 @@ CODES: Dict[str, Tuple[str, str]] = {
     "GLS211": (WARNING, "stray or orphaned entry in the checkpoint directory"),
     "GLS212": (ERROR, "malformed checkpoint manifest or inconsistent provenance"),
     "GLS213": (WARNING, "checkpoint predates provenance (not elastically resumable)"),
+    "GLS214": (ERROR, "checkpoint bytes no longer match the manifest's integrity digest"),
     # ---- code linter (GLC0xx) ----
     "GLC001": (ERROR, "jax attribute chain missing from the installed jax"),
     "GLC002": (WARNING, "host-side numpy call inside a jitted function"),

@@ -481,6 +481,8 @@ def lint_hp(
     file: Optional[str] = None,
     anomaly_guard: Optional[bool] = None,
     mode: Optional[str] = None,
+    sdc_check: Optional[str] = None,
+    sdc_interval: Optional[int] = None,
 ) -> D.DiagnosticReport:
     """Lint an already-constructed config (the train-driver / search-engine
     hook): engine-consistency + model-aware checks + cost warnings. The
@@ -491,7 +493,11 @@ def lint_hp(
     ``mode`` is likewise driver state: "serve" turns on the GLS014
     serve-feasibility layer (cli/serve and the serve-objective search),
     "train" warns GLS103 on inert serve knobs; None (file-level lint
-    without --serve) runs neither."""
+    without --serve) runs neither. ``sdc_check``/``sdc_interval`` are the
+    silent-corruption sentinel flags: voting on a layout with no per-device
+    replica (runtime/sdc.vote_reason) silently downgrades at runtime, and
+    an interval with the sentinel off is inert — both warned GLS103 here so
+    the operator learns it before a multi-day run does."""
     report = D.DiagnosticReport()
     report.extend(hp.structural_diagnostics())
     report.extend(hp.pipeline_engine_diagnostics())
@@ -514,6 +520,23 @@ def lint_hp(
             "train mode: admission control and overload shedding live in "
             "the serve batcher, not the training loop",
             key="serve_p99_ttft_ms",
+        ))
+    if sdc_check == "vote":
+        from galvatron_tpu.runtime.sdc import vote_reason
+
+        reason = vote_reason(hp)
+        if reason is not None:
+            report.add(D.make(
+                "GLS103", "sdc_check=vote downgrades to digest on this "
+                "layout (%s): cross-replica voting needs a full per-device "
+                "parameter replica" % reason,
+                key="sdc_check",
+            ))
+    if sdc_interval and (sdc_check or "off") == "off":
+        report.add(D.make(
+            "GLS103", "sdc_interval is inert with sdc_check off: there is "
+            "no integrity digest to emit",
+            key="sdc_interval",
         ))
     if file:
         report.diagnostics = [

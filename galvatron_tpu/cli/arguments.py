@@ -271,6 +271,31 @@ def _add_train_args(p: argparse.ArgumentParser):
                         "devices in memory (--elastic_strategy JSON if "
                         "given, else a fresh search) instead of exiting; "
                         "SIGUSR1 triggers the same migration manually")
+    # silent-corruption sentinel (runtime/sdc.py): in-jit integrity digests,
+    # cross-replica voting, strike ladder -> quarantine -> migration
+    r.add_argument("--sdc_check", type=str, default="off",
+                   choices=("off", "digest", "vote"),
+                   help="silent-data-corruption sentinel: 'digest' adds a "
+                        "layout-invariant integrity digest of the params as "
+                        "a pure step side-output (bitwise-transparent); "
+                        "'vote' additionally digests every data-parallel "
+                        "replica's input params under shard_map and "
+                        "majority-votes at drain time — a lying device is "
+                        "localized, the frozen state repaired from a "
+                        "healthy replica, the step re-executed, and repeat "
+                        "offenders quarantined into --migrate_on_degrade; "
+                        "downgrades to 'digest' with a log line when the "
+                        "layout has no dp redundancy to vote with")
+    r.add_argument("--sdc_interval", type=int, default=None,
+                   help="emit the sdc_check telemetry heartbeat every N "
+                        "drained steps (default 1; digests are computed "
+                        "in-jit regardless so the compiled program does not "
+                        "depend on the interval)")
+    r.add_argument("--sdc_strikes", type=int, default=2,
+                   help="consecutive mismatch observations naming the same "
+                        "device before it is quarantined (each observation "
+                        "first repairs + re-executes; a tie vote only ever "
+                        "re-executes)")
 
 
 def _add_profile_args(p: argparse.ArgumentParser):

@@ -41,6 +41,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "per-iteration manifest integrity, provenance "
                         "presence/consistency, embedded-strategy lint "
                         "(GLS21x; no arrays are restored)")
+    p.add_argument("--deep", action="store_true",
+                   help="with --ckpt: restore every array item and verify "
+                        "its layout-invariant integrity fold against the "
+                        "manifest (GLS214) — catches bit rot between save "
+                        "and resume at the cost of reading the checkpoint")
     p.add_argument("--json", dest="as_json", action="store_true",
                    help="machine-readable JSON output")
     p.add_argument("--strict", action="store_true",
@@ -133,7 +138,8 @@ def run(argv: Optional[List[str]] = None) -> int:
         if not os.path.isdir(ckpt_dir):
             print("cannot audit %s: not a directory" % ckpt_dir, file=sys.stderr)
             return 2
-        report.extend(K.audit_checkpoint_dir(ckpt_dir).diagnostics)
+        report.extend(
+            K.audit_checkpoint_dir(ckpt_dir, deep=args.deep).diagnostics)
 
     print(report.to_json() if args.as_json else report.render())
     if args.strict and report.warnings:

@@ -224,6 +224,8 @@ def _train(args) -> dict:
         # mode="train" flags inert serve knobs (GLS103)
         anomaly_guard=bool(getattr(args, "anomaly_guard", 0)),
         mode="train",
+        sdc_check=getattr(args, "sdc_check", None),
+        sdc_interval=getattr(args, "sdc_interval", None),
     )
     if jax.process_index() == 0:
         for _d in _report.warnings:
@@ -275,6 +277,27 @@ def _train(args) -> dict:
     # families with their own param tree (t5/swin) supply a build hook
     model = fam.build(cfg, hp) if fam.build else construct_hybrid_parallel_model(cfg, hp)
     tx, _sched = get_optimizer_and_scheduler(optimizer_args_from(args))
+
+    # ------------------------------------------ silent-corruption sentinel
+    # runtime/sdc.py: in-jit integrity digests ("digest"), per-replica vote
+    # + freeze + drain-time repair/re-execute ("vote"), and the strike
+    # ladder that quarantines a persistently-lying device into the
+    # degraded-mesh migration path. Digests are computed in-jit whenever
+    # the sentinel is on; --sdc_interval only gates heartbeat emission, so
+    # the compiled program does not depend on the interval.
+    from galvatron_tpu.runtime import sdc as sdc_mod
+
+    sdc_mode = getattr(args, "sdc_check", "off") or "off"
+    sdc_interval = max(int(getattr(args, "sdc_interval", 0) or 1), 1)
+    sdc_ladder = None
+    if sdc_mode == "vote":
+        sdc_ladder = sdc_mod.VoteLadder(
+            strikes=max(int(getattr(args, "sdc_strikes", 2) or 2), 1))
+    sdc_quarantined = set()  # device ids convicted by the strike ladder
+    sdc_req = {"pending": False, "votes": None, "tie_rounds": 0}
+
+    def sdc_vote_ids():
+        return sdc_mod.vote_device_ids(model.mesh, sdc_mod.dp_axes_of(model))
 
     # Decomposed-TP overlap accounting: under tp_comm_mode=overlap, measure
     # per TP LayerRun how much communication the chunked ppermute schedule
@@ -348,6 +371,7 @@ def _train(args) -> dict:
             verify_integrity=verify_ckpt,
             retry_policy=retry_policy,
             counters=res,
+            sdc_check=sdc_mode != "off",
         )
         if elastic_plan is not None and elastic_plan.cross_strategy:
             # strategy-portable restore into THIS model's shardings; the
@@ -387,12 +411,27 @@ def _train(args) -> dict:
         resumed_from=args.load or None,
     )
 
-    step_fn = model.make_train_step(
-        tx, guard_anomalies=guard is not None,
-        donate=bool(getattr(args, "donate_step", 1)),
-    )
-    if hooks is not None and hooks.wrap_step_fn:
-        step_fn = hooks.wrap_step_fn(step_fn)
+    def build_step_fn():
+        """The jitted step for the CURRENT model/hp — also the rebuild path
+        after a live migration, where the sentinel downgrades vote->digest
+        when the new layout has no dp redundancy left to vote with."""
+        nonlocal sdc_mode
+        if sdc_mode == "vote":
+            reason = sdc_mod.vote_reason(hp)
+            if reason is not None:
+                telemetry.runtime_log(
+                    "sdc_check=vote downgraded to digest: %s" % reason)
+                sdc_mode = "digest"
+        fn = model.make_train_step(
+            tx, guard_anomalies=guard is not None,
+            donate=bool(getattr(args, "donate_step", 1)),
+            sdc_check=sdc_mode,
+        )
+        if hooks is not None and hooks.wrap_step_fn:
+            fn = hooks.wrap_step_fn(fn)
+        return fn
+
+    step_fn = build_step_fn()
 
     # Separate the one-off program-build cost (trace + XLA compile) from the
     # steady-state step time: AOT-lower and compile at the first batch with
@@ -735,6 +774,25 @@ def _train(args) -> dict:
         loss = float(metrics["loss"])
         emit_step_event(d_it, metrics, loss, disp_ms)
         maybe_stop_trace(d_it)
+        if sdc_ladder is not None and isinstance(metrics, dict) \
+                and metrics.get("sdc_mismatch") is not None \
+                and bool(metrics["sdc_mismatch"]):
+            # replica vote disagreed: the jitted step already froze
+            # params/opt_state (keep-old select), and this step's loss came
+            # from a corrupt replica — record nothing; drain_inflight runs
+            # the repair/re-execute/escalate ladder
+            sdc_req.update(pending=True, votes=[
+                int(v) for v in np.asarray(metrics["sdc_votes"]).ravel()])
+            return d_it, False
+        if sdc_mode != "off" and isinstance(metrics, dict) \
+                and metrics.get("sdc_fold") is not None \
+                and d_it % sdc_interval == 0:
+            res.sdc_checks += 1
+            telemetry.emit(
+                "sdc_check", mode=sdc_mode, iter=d_it,
+                fold=int(metrics["sdc_fold"]),
+                sumsq=float(metrics["sdc_sumsq"]),
+            )
         verdict = guard.observe(loss) if guard is not None else "ok"
         if verdict == "ok":
             losses.append(loss)
@@ -755,6 +813,80 @@ def _train(args) -> dict:
             )
         return d_it, guard.should_roll_back
 
+    def sdc_recover(d_it, votes):
+        """A drained step's replica vote disagreed. The jitted step froze
+        params/opt_state, and every later in-flight step carried the frozen
+        (still-corrupt) state forward through the same select, so the whole
+        window is abandoned and the driver's newest params ARE the
+        mismatching step's input state. Vote on the host, repair the
+        convicted replica from a healthy peer, reopen the stream at the
+        mismatching step and re-execute — bitwise identical to a clean run
+        because the digest fold is exact. Repeat offenders escalate through
+        the strike ladder into the degraded-mesh migration path."""
+        nonlocal it, params, opt_state
+        verdict = sdc_ladder.observe(votes, sdc_vote_ids())
+        res.sdc_mismatches += 1
+        suspects = verdict["suspects"]
+        telemetry.emit(
+            "sdc_mismatch", iter=d_it, action=verdict["action"],
+            suspects=suspects or None, folds=votes,
+            strikes=verdict["strikes"] or None,
+        )
+        if jax.process_index() == 0:
+            print(
+                "iteration %d: replica vote mismatch (%s) — %s%s"
+                % (d_it, " ".join("0x%08x" % v for v in votes),
+                   verdict["action"],
+                   " (suspect devices %s)" % suspects if suspects else "")
+            )
+        inflight.clear()  # descendants of the frozen state
+        if suspects:
+            sdc_req["tie_rounds"] = 0
+            params = sdc_mod.repair_from_replica(params, suspects)
+            opt_state = sdc_mod.repair_from_replica(opt_state, suspects)
+        else:
+            # detected but not localizable (tied vote, e.g. dp=2): the only
+            # move is re-executing and hoping the lie was transient — but a
+            # persistent tie would re-execute forever, so bound it
+            sdc_req["tie_rounds"] += 1
+            if sdc_req["tie_rounds"] > sdc_ladder.strikes:
+                raise rsl.TrainingAnomalyError(
+                    "replica digests keep disagreeing with no majority at "
+                    "iteration %d (%d consecutive tied votes); cannot "
+                    "localize the lying device"
+                    % (d_it, sdc_req["tie_rounds"]))
+        res.sdc_reexecutions += 1
+        it = d_it
+        open_stream(d_it)
+        if verdict["quarantine"]:
+            sdc_quarantined.update(int(d) for d in verdict["quarantine"])
+            res.sdc_quarantines += 1
+            avail = [d for d in jax.devices()
+                     if int(d.id) not in sdc_quarantined]
+            telemetry.emit(
+                "sdc_quarantine", iter=d_it,
+                device_ids=sorted(int(d) for d in verdict["quarantine"]),
+                strikes=verdict["strikes"] or None, reason="replica_vote")
+            if jax.process_index() == 0:
+                print(
+                    "iteration %d: device(s) %s quarantined after %d "
+                    "consecutive strikes — %d device(s) survive"
+                    % (d_it, sorted(verdict["quarantine"]),
+                       sdc_ladder.strikes, len(avail)))
+            if mesh_monitor is not None:
+                # future probes keep reporting the world degraded until the
+                # run migrates off the convicted device
+                mesh_monitor.quarantine(verdict["quarantine"])
+            if getattr(args, "migrate_on_degrade", 0):
+                migrate_req.update(pending=True, reason="sdc_quarantine",
+                                   world=len(avail))
+            else:
+                raise rsl.TrainingAnomalyError(
+                    "device(s) %s convicted of silent corruption at "
+                    "iteration %d; restart without them or pass "
+                    "--migrate_on_degrade 1 to migrate off them in place"
+                    % (sorted(verdict["quarantine"]), d_it))
+
     def drain_inflight(window: int) -> bool:
         """Drain until at most `window` steps remain in flight (window=0 is
         the forced drain at eval/save/preemption boundaries and in the
@@ -766,6 +898,10 @@ def _train(args) -> dict:
         nonlocal it, params, opt_state
         while len(inflight) > window:
             d_it, need_rollback = drain_one()
+            if sdc_req["pending"]:
+                sdc_req.update(pending=False)
+                sdc_recover(d_it, sdc_req["votes"])
+                return True
             if not need_rollback:
                 continue
             intact = ckpt.intact_iterations(args.save) if args.save else []
@@ -829,8 +965,26 @@ def _train(args) -> dict:
             # trajectory wins this boundary; the migration request is dropped
             # (the next probe/SIGUSR1 re-raises it against the restored run)
             return False
-        world = int(target_world or len(jax.devices()))
-        new_hp, action = els.resolve_migration_strategy(args, cfg, world, hp)
+        avail = [d for d in jax.devices() if int(d.id) not in sdc_quarantined]
+        world = int(target_world or len(avail))
+        new_hp = action = None
+        last_err = None
+        for w in range(world, 0, -1):
+            try:
+                new_hp, action = els.resolve_migration_strategy(args, cfg, w, hp)
+                world = w
+                break
+            except DiagnosticError as e:
+                # a quarantined world (e.g. 3 of 4 devices) often has no
+                # feasible strategy at its exact size; shrink until one fits
+                last_err = e
+                if reason != "sdc_quarantine":
+                    raise
+        if new_hp is None:
+            raise last_err
+        if world < len(avail) and jax.process_index() == 0:
+            print("migration (%s): no feasible strategy for all %d surviving "
+                  "device(s); migrating to %d" % (reason, len(avail), world))
         if new_hp.to_json_dict() == hp.to_json_dict() and world == hp.world_size:
             # resolve BEFORE tearing anything down: a no-op request (already
             # on the target strategy — e.g. a repeated trigger) leaves the
@@ -840,26 +994,27 @@ def _train(args) -> dict:
                 "running one; nothing to swap" % reason)
             return False
         close_stream()
-        devs = jax.devices()[:world] if world != hp.world_size else None
+        devs = avail[:world] \
+            if (world != hp.world_size or sdc_quarantined) else None
         build = None
         if fam.build:
             build = lambda c, h, d=None: fam.build(c, h)  # noqa: E731
         result = els.migrate(
             model, params, opt_state, tx, new_hp, devices=devs,
             build_model=build, reason=reason, iteration=it,
+            sdc_check=sdc_mode != "off",
         )
         model, params, opt_state = result.model, result.params, result.opt_state
         hp = new_hp
         provenance = els.build_provenance(
             hp, cfg, optimizer_args_from(args), mesh=model.mesh,
             memory_budget_gb=getattr(args, "elastic_memory_gb", None))
-        step_fn = model.make_train_step(
-            tx, guard_anomalies=guard is not None,
-            donate=bool(getattr(args, "donate_step", 1)),
-        )
-        if hooks is not None and hooks.wrap_step_fn:
-            step_fn = hooks.wrap_step_fn(step_fn)
+        step_fn = build_step_fn()
         _aot["fn"] = None  # re-lower; the executable memo absorbs repeats
+        if sdc_ladder is not None:
+            # the convicted device is out of the new mesh; surviving devices
+            # start with a clean slate
+            sdc_ladder.reset()
         if eval_fn is not None:
             eval_fn = jax.jit(model.eval_loss)
             for split in eval_batches:
@@ -871,6 +1026,7 @@ def _train(args) -> dict:
             mesh_monitor = hlth.MeshHealthMonitor(
                 model.mesh, interval_s=mesh_monitor.interval_s,
                 devices_fn=getattr(args, "probe_devices_fn", None),
+                quarantined_ids=set(mesh_monitor.quarantined_ids),
             )
         open_stream(it)
         if jax.process_index() == 0:

@@ -17,6 +17,10 @@ produces the numbers the perf loop runs on:
 - **divergence table** — the per-LayerRun predicted-vs-measured join
   (obs/attribution.py) using the steady step time and the compiled-step
   memory recorded by the ``compile`` event.
+- **integrity rollup** — when the silent-corruption sentinel ran
+  (``train --sdc_check``): digest heartbeats, cross-replica vote
+  mismatches with the suspected device ids, re-executions, quarantines,
+  and state-motion continuity checks.
 - **serving rollup** — when the stream carries ``serve_request`` /
   ``decode_batch`` events (``cli serve --telemetry``): TTFT/TPOT
   percentiles, decode-step occupancy, and output tokens/s; plus the
@@ -46,9 +50,12 @@ TIMELINE_TYPES = (
     "compile", "checkpoint_save", "checkpoint_restore", "checkpoint_gc",
     "anomaly_skip", "rollback", "retry", "preemption", "watchdog", "elastic",
     "trace", "eval", "serve_drain", "serve_migrate",
+    "sdc_mismatch", "sdc_quarantine",
 )
 # serve_shed is deliberately NOT on the timeline: a shedding server emits
-# one per rejected request, which under overload is most of the load
+# one per rejected request, which under overload is most of the load.
+# sdc_check is off it for the same reason: it is a per-interval heartbeat,
+# not a lifecycle transition — only mismatches and quarantines are.
 
 # timeline rendering: the watchdog's stack dump and a migration's full
 # strategy JSON are post-mortem payloads, not one-line timeline material
@@ -136,6 +143,42 @@ def _serving_section(
         "migrations": len(migrates),
         "migrated_worlds": [
             [e.get("from_world"), e.get("to_world")] for e in migrates],
+    }
+
+
+def _integrity_section(
+    checks: List[Dict[str, Any]],
+    mismatches: List[Dict[str, Any]],
+    quarantines: List[Dict[str, Any]],
+) -> Dict[str, Any]:
+    """Silent-corruption sentinel rollup (sdc_check / sdc_mismatch /
+    sdc_quarantine events). Heartbeats carry the step-mode digests; the
+    mode=="continuity" checks are the GLS016 asserts around state motion
+    (relayout / migrate / cross-layout restore) and are counted apart."""
+    heartbeats = [e for e in checks if e.get("mode") != "continuity"]
+    continuity = [e for e in checks if e.get("mode") == "continuity"]
+    reexecs = sum(1 for e in mismatches if e.get("action") == "reexecute")
+    suspects: Dict[str, int] = {}
+    for e in mismatches:
+        for dev in e.get("suspects") or ():
+            suspects[str(dev)] = suspects.get(str(dev), 0) + 1
+    return {
+        "mode": heartbeats[-1].get("mode") if heartbeats else None,
+        "checks": len(heartbeats),
+        "continuity_checks": len(continuity),
+        "continuity_sites": sorted(
+            {e.get("where") for e in continuity if e.get("where")}),
+        "mismatches": len(mismatches),
+        "mismatch_rate": (len(mismatches) / (len(heartbeats) + len(mismatches))
+                          if (heartbeats or mismatches) else None),
+        "reexecutions": reexecs,
+        "suspect_counts": dict(sorted(suspects.items())),
+        "quarantines": len(quarantines),
+        "quarantined_devices": sorted(
+            {int(d) for e in quarantines for d in (e.get("device_ids") or ())}),
+        "last_fold": (("0x%08x" % int(heartbeats[-1]["fold"]))
+                      if heartbeats and heartbeats[-1].get("fold") is not None
+                      else None),
     }
 
 
@@ -246,6 +289,12 @@ def analyze(
         "quant_comm": quant_events,
         "timeline": timeline,
     }
+    sdc_checks = by_type.get("sdc_check", [])
+    sdc_mismatches = by_type.get("sdc_mismatch", [])
+    sdc_quarantines = by_type.get("sdc_quarantine", [])
+    if sdc_checks or sdc_mismatches or sdc_quarantines:
+        analysis["integrity"] = _integrity_section(
+            sdc_checks, sdc_mismatches, sdc_quarantines)
     serve_reqs = by_type.get("serve_request", [])
     decode_batches = by_type.get("decode_batch", [])
     sheds = by_type.get("serve_shed", [])
@@ -338,6 +387,33 @@ def render(analysis: Dict[str, Any]) -> str:
                    _fmt(e.get("stop", 1) - 1 if e.get("stop") is not None else None),
                    _fmt(e.get("overlap_ms")), _fmt(e.get("serial_ms")),
                    _fmt(e.get("comm_hidden_ms")))
+            )
+    if analysis.get("integrity"):
+        iv = analysis["integrity"]
+        lines.append("")
+        lines.append("integrity (silent-corruption sentinel):")
+        lines.append(
+            "  mode %s | %s digest checks (last fold %s) | %s continuity "
+            "checks%s"
+            % (_fmt(iv["mode"]), _fmt(iv["checks"]), _fmt(iv["last_fold"]),
+               _fmt(iv["continuity_checks"]),
+               (" (%s)" % ", ".join(iv["continuity_sites"])
+                if iv["continuity_sites"] else ""))
+        )
+        if iv["mismatches"]:
+            suspects = " ".join(
+                "dev%s=%d" % (k, v) for k, v in iv["suspect_counts"].items())
+            lines.append(
+                "  mismatches: %s (rate %s), %s re-executions%s"
+                % (_fmt(iv["mismatches"]), _fmt(iv["mismatch_rate"]),
+                   _fmt(iv["reexecutions"]),
+                   (" | suspects %s" % suspects) if suspects else "")
+            )
+        if iv["quarantines"]:
+            lines.append(
+                "  quarantines: %s, devices %s"
+                % (_fmt(iv["quarantines"]),
+                   ",".join(str(d) for d in iv["quarantined_devices"]))
             )
     if analysis.get("serving"):
         sv = analysis["serving"]

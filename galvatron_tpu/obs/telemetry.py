@@ -171,6 +171,26 @@ EVENT_SCHEMAS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
         ("replayed", "shed", "duration_ms", "reason", "from_strategy",
          "to_strategy", "kv_slots", "kv_pages"),
     ),
+    # silent-corruption sentinel (runtime/sdc.py). sdc_check is the
+    # high-volume heartbeat — one per digested step (mode="digest"/"vote",
+    # gated by --sdc_interval) or per continuity assert (mode="continuity",
+    # state motion named by `where`); like serve_shed it stays OFF the
+    # report timeline. sdc_mismatch is one vote round that disagreed
+    # (suspects = localized device ids, action = reexecute|quarantine);
+    # sdc_quarantine is the strike-ladder escalation that feeds the
+    # degraded-mesh migration path, naming the lying device ids.
+    "sdc_check": (
+        ("mode",),
+        ("iter", "fold", "sumsq", "where"),
+    ),
+    "sdc_mismatch": (
+        ("iter", "action"),
+        ("suspects", "folds", "strikes"),
+    ),
+    "sdc_quarantine": (
+        ("iter", "device_ids"),
+        ("strikes", "reason"),
+    ),
     # jax.profiler start/stop_trace bracketing (--xla_trace)
     "trace": (("action",), ("dir", "first_step", "last_step", "error")),
     "log": (("message",), ()),

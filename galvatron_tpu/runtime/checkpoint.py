@@ -109,11 +109,19 @@ def _manifest_path(ckpt_dir: str, iteration: int) -> str:
 
 def _tree_digests(tree: Any) -> Dict[str, Any]:
     """Per-item integrity record: value digest (None when shards are not
-    addressable), structure-only digest, leaf count."""
+    addressable), structure-only digest, leaf count, and the
+    sharding-layout-invariant integrity fold (runtime/sdc.py). The sha256
+    covers the exact host bytes in tree order — torn/partial writes; the
+    fold survives any relayout, so `cli lint --ckpt --deep` (GLS214) and a
+    cross-strategy resume can both check the VALUES independently of how
+    the restoring run shards them."""
+    from galvatron_tpu.runtime import sdc
+
     value = hashlib.sha256()
     spec = hashlib.sha256()
     leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
     addressable = True
+    fold = 0
     for path, leaf in leaves:
         key = jax.tree_util.keystr(path).encode()
         try:
@@ -125,6 +133,7 @@ def _tree_digests(tree: Any) -> Dict[str, Any]:
             spec.update(key + str(arr.dtype).encode() + str(arr.shape).encode())
             value.update(key + str(arr.dtype).encode() + str(arr.shape).encode())
             value.update(arr.tobytes())
+            fold = (fold + sdc.host_tree_fold(arr)) & 0xFFFFFFFF
         else:
             spec.update(key)
             addressable = False
@@ -132,6 +141,7 @@ def _tree_digests(tree: Any) -> Dict[str, Any]:
         "digest": value.hexdigest() if addressable else None,
         "spec_digest": spec.hexdigest(),
         "num_leaves": len(leaves),
+        "fold": fold if addressable else None,
     }
 
 
@@ -449,6 +459,7 @@ def load_checkpoint(
     saved_strategy: Optional[HybridParallelConfig] = None,
     retry_policy: Any = None,
     counters: Any = None,
+    sdc_check: bool = False,
 ):
     """Restore (params, opt_state, train_meta) re-sharded to the current mesh.
 
@@ -651,6 +662,17 @@ def load_checkpoint(
         )
     params = out["params"]
     opt_state = out.get("opt_state")
+    params_fold = opt_fold = None
+    if sdc_check and target is not None and cross:
+        # the layout-invariant fold of the AS-RESTORED state, asserted
+        # unchanged across the relayout + placement below (GLS016): the
+        # manifest sha256 cannot make this check — it is bound to the saved
+        # strategy's exact byte layout
+        from galvatron_tpu.runtime import sdc
+
+        params_fold = sdc.host_tree_fold(params)
+        if opt_state is not None and tx is not None:
+            opt_fold = sdc.host_tree_fold(opt_state)
     if target is not None and cross:
         # integrity was verified on the AS-SAVED tree above; now re-lay-out
         # (leaf-exact host-side data movement) and place onto the target mesh
@@ -672,6 +694,16 @@ def load_checkpoint(
                 )])
             opt_state = jax.device_put(
                 opt_state, target.opt_state_shardings(tx, target_abs_params))
+        if params_fold is not None:
+            from galvatron_tpu.runtime import sdc
+
+            sdc.assert_digest_continuity(
+                params_fold, params, "load_checkpoint(cross, params)",
+                iteration=iteration)
+            if opt_fold is not None and opt_state is not None:
+                sdc.assert_digest_continuity(
+                    opt_fold, opt_state, "load_checkpoint(cross, opt_state)",
+                    iteration=iteration)
     meta = out.get("train_meta") or {}
     meta.setdefault("iteration", iteration)
     if torn:

@@ -532,6 +532,7 @@ def migrate(
     build_model: Any = None,
     reason: str = "manual",
     iteration: Optional[int] = None,
+    sdc_check: bool = False,
 ) -> MigrationResult:
     """Hot-swap the LIVE training state onto `target_hp` without a
     checkpoint round-trip.
@@ -553,12 +554,24 @@ def migrate(
 
     `build_model` overrides model construction for families with their own
     build hook; `devices` selects the surviving device subset on a shrink.
-    The swap is logged as an ``elastic`` telemetry event carrying the full
+    With `sdc_check` the layout-invariant integrity digest (runtime/sdc.py)
+    is recorded before the move and asserted unchanged after relayout +
+    placement — GLS016 refusal instead of silently garbling state. The swap
+    is logged as an ``elastic`` telemetry event carrying the full
     before/after strategy JSON."""
     import jax
 
     from galvatron_tpu.runtime import checkpoint as ckpt
     from galvatron_tpu.runtime.model_api import construct_hybrid_parallel_model
+
+    sdc = None
+    params_fold = opt_fold = None
+    if sdc_check:
+        from galvatron_tpu.runtime import sdc
+
+        params_fold = sdc.host_tree_fold(params)
+        if opt_state is not None:
+            opt_fold = sdc.host_tree_fold(opt_state)
 
     old_hp: HybridParallelConfig = model.hp
     same_layout = ckpt._same_param_layout(old_hp, target_hp)
@@ -604,6 +617,15 @@ def migrate(
             )])
         new_opt = jax.device_put(
             relaid, new_model.opt_state_shardings(tx, target_abs_params))
+
+    if sdc_check:
+        # the whole move — stage restack + sharded device_put — is
+        # value-preserving by contract; the layout-invariant fold proves it
+        sdc.assert_digest_continuity(
+            params_fold, new_params, "migrate(params)", iteration=iteration)
+        if opt_fold is not None and new_opt is not None:
+            sdc.assert_digest_continuity(
+                opt_fold, new_opt, "migrate(opt_state)", iteration=iteration)
 
     telemetry.emit(
         "elastic", action="migrate", reason=reason, iter=iteration,
@@ -761,10 +783,13 @@ def migrate_serve_params(
     target_hp: HybridParallelConfig,
     devices: Any = None,
     build_model: Any = None,
+    sdc_check: bool = False,
 ) -> Tuple[Any, Any, bool]:
     """Params-only live relayout for a serve migration: the inference twin
     of :func:`migrate` with no optimizer state and no trajectory checks
     (serving has no training trajectory to fork — global_bsz is inert).
+    With `sdc_check` the layout-invariant digest is asserted unchanged
+    across the move (GLS016 on mismatch), like :func:`migrate`.
     Returns (new_model, new_params, same_layout); the caller rebuilds the
     ServeEngine (fresh KV cache in the new layout) and journal-replays the
     in-flight requests (serve/engine.ContinuousBatcher.migrate_to)."""
@@ -772,6 +797,12 @@ def migrate_serve_params(
 
     from galvatron_tpu.runtime import checkpoint as ckpt
     from galvatron_tpu.runtime.model_api import construct_hybrid_parallel_model
+
+    params_fold = None
+    if sdc_check:
+        from galvatron_tpu.runtime import sdc
+
+        params_fold = sdc.host_tree_fold(params)
 
     old_hp: HybridParallelConfig = model.hp
     same_layout = ckpt._same_param_layout(old_hp, target_hp)
@@ -790,4 +821,9 @@ def migrate_serve_params(
     else:
         new_params = jax.device_put(
             ckpt._relayout_tree(params, old_hp, target_hp), new_model.shardings())
+    if params_fold is not None:
+        from galvatron_tpu.runtime import sdc
+
+        sdc.assert_digest_continuity(
+            params_fold, new_params, "migrate_serve_params")
     return new_model, new_params, same_layout

@@ -382,7 +382,13 @@ class MeshHealthMonitor:
 
     `expected_ids` come from the running strategy's mesh; `devices_fn` is
     injectable so tests can simulate device loss without killing real
-    devices."""
+    devices.
+
+    `quarantined_ids` holds devices other subsystems have convicted (the
+    silent-corruption voter in runtime/sdc.py): a quarantined device is
+    treated as missing even though enumeration still lists it — the lie is
+    in its arithmetic, not its liveness — so every later probe keeps
+    reporting the world degraded until the run migrates off it."""
 
     mesh: Any
     interval_s: float = 60.0
@@ -392,6 +398,7 @@ class MeshHealthMonitor:
     collective: bool = True  # enumeration diff only when False (cheaper)
     _next_due: Optional[float] = field(default=None, repr=False)
     expected_ids: Sequence[int] = ()
+    quarantined_ids: set = field(default_factory=set)
 
     def __post_init__(self):
         if self.devices_fn is None:
@@ -412,8 +419,19 @@ class MeshHealthMonitor:
         self._next_due = now + self.interval_s
         return self.probe()
 
+    def quarantine(self, device_ids: Sequence[int]) -> Dict[str, Any]:
+        """Convict `device_ids` and return the immediate (degraded) verdict
+        the caller can feed straight into its migrate-on-degrade handler —
+        no need to wait for the next scheduled probe."""
+        self.quarantined_ids.update(int(i) for i in device_ids)
+        return self.probe()
+
     def probe(self) -> Dict[str, Any]:
-        verdict = classify_world(self.expected_ids, self.devices_fn())
+        live = [d for d in self.devices_fn()
+                if int(getattr(d, "id", d)) not in self.quarantined_ids]
+        verdict = classify_world(self.expected_ids, live)
+        if self.quarantined_ids:
+            verdict["quarantined_ids"] = sorted(self.quarantined_ids)
         if self.collective and verdict["status"] == "healthy":
             coll = probe_collective(self.mesh, timeout_s=self.timeout_s)
             verdict["collective_ok"] = coll["ok"]

@@ -191,7 +191,8 @@ class HybridParallelModel:
         )
 
     def make_train_step(self, tx: optax.GradientTransformation, *,
-                        guard_anomalies: bool = False, donate: bool = True):
+                        guard_anomalies: bool = False, donate: bool = True,
+                        sdc_check: str = "off"):
         """The jitted (params, opt_state, batch[, spike_cap]) -> (params,
         opt_state, metrics) step. With `guard_anomalies` the step takes a
         fourth `spike_cap` scalar and refuses to apply an update whose loss
@@ -206,8 +207,42 @@ class HybridParallelModel:
         donated input buffer is still being produced by the previous call —
         donation there serializes host and device no matter how far ahead
         the host dispatches. TPU runtimes handle donated futures
-        asynchronously, so production keeps the default."""
+        asynchronously, so production keeps the default.
+
+        `sdc_check` (runtime/sdc.py) adds silent-corruption side-outputs.
+        "digest": metrics gain the layout-invariant integrity fold +
+        sum-of-squares of the returned params — near-zero cost, composes
+        with donation, pp scan, and every tp_comm_mode; the update program
+        is untouched, so sentinel-on and sentinel-off trajectories stay
+        bitwise identical. "vote" additionally digests each device's
+        *input-param* replica under a shard_map manual over the dp axes
+        (metrics["sdc_votes"], flat order = sdc.vote_device_ids) and
+        freezes params/opt_state through the same keep-old select the
+        anomaly guard uses whenever the replicas disagree
+        (metrics["sdc_mismatch"]) — a lying device cannot leak into the
+        psummed update, and the driver repairs + re-executes at drain time.
+        Voting requires sdc.vote_supported; callers downgrade to "digest"
+        on unsupported layouts (the driver logs it, strategy_lint warns
+        GLS103). Note the manual shard_map region legally shifts GSPMD
+        partitioning decisions for the rest of the module, so a "vote" run
+        may differ from an "off" run in last-bit float rounding; it is
+        deterministic, and re-execution after a repair is bitwise identical
+        to a clean run *in the same mode* — which is the comparison the
+        fault sims make."""
         hp, mesh = self.hp, self.mesh
+        from galvatron_tpu.runtime import sdc as SDC
+
+        if sdc_check not in SDC.SDC_MODES:
+            raise ValueError("sdc_check must be one of %r, got %r"
+                             % (SDC.SDC_MODES, sdc_check))
+        vote_fn = None
+        if sdc_check == "vote":
+            reason = SDC.vote_reason(hp)
+            if reason is not None:
+                raise ValueError(
+                    "sdc_check='vote' unsupported for this layout (%s); "
+                    "callers should downgrade to 'digest'" % reason)
+            vote_fn = SDC.make_vote_digest_fn(self)
         # pp>1: the scan pipeline consumes the whole batch as `chunks`
         # microbatches itself — no outer accumulation loop.
         chunks = 1 if hp.pp > 1 else hp.chunks
@@ -311,6 +346,27 @@ class HybridParallelModel:
                 # moments AND the schedule counter stay put)
                 new_opt_state = jax.tree.map(keep, new_opt_state, opt_state)
                 metrics["anomalous"] = bad
+            if vote_fn is not None:
+                # per-replica digests of the INPUT params: the dp redundancy
+                # the layout already pays for. On any disagreement the state
+                # freezes through the same keep-old select the guard uses —
+                # the lying replica stays localized to its device instead of
+                # riding the psummed update onto every replica; the driver
+                # repairs from a healthy replica and re-executes.
+                votes = vote_fn(params)
+                mismatch = jnp.any(votes != jnp.ravel(votes)[0])
+                keep_sdc = lambda new, old: jnp.where(mismatch, old, new)  # noqa: E731
+                new_params = jax.tree.map(keep_sdc, new_params, params)
+                new_opt_state = jax.tree.map(keep_sdc, new_opt_state, opt_state)
+                metrics["sdc_votes"] = votes
+                metrics["sdc_mismatch"] = mismatch
+            if sdc_check != "off":
+                # layout-invariant digest of the state this step hands back
+                # (post-select): pure side-outputs, so the trajectory is
+                # bitwise identical to a sentinel-off run
+                fold, sumsq = SDC.tree_fold_metrics(new_params)
+                metrics["sdc_fold"] = fold
+                metrics["sdc_sumsq"] = sumsq
             return new_params, new_opt_state, metrics
 
         donate_argnums = (0, 1) if donate else ()

@@ -61,6 +61,11 @@ class ResilienceCounters:
     retries_exhausted: int = 0  # operations that gave up (budget or elapsed cap)
     emergency_saves: int = 0
     torn_checkpoints_skipped: int = 0
+    # silent-corruption sentinel (runtime/sdc.py)
+    sdc_checks: int = 0  # digest observations emitted to telemetry
+    sdc_mismatches: int = 0  # drain-time replica-vote disagreements
+    sdc_reexecutions: int = 0  # repair-from-replica + re-execute recoveries
+    sdc_quarantines: int = 0  # devices convicted by the strike ladder
 
     def as_dict(self) -> Dict[str, int]:
         return dataclasses.asdict(self)

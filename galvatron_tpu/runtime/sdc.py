@@ -1,0 +1,289 @@
+"""Silent-data-corruption sentinel: digests, replica voting, quarantine.
+
+Every failure the runtime survives today is *loud*: AnomalyGuard catches
+non-finite losses, the Watchdog catches hangs, MeshHealthMonitor catches
+enumeration/collective failures. A marginal accelerator that returns
+finite-but-wrong values passes all three, poisons the optimizer state, and
+gets sha256-sealed into "intact" checkpoints. This module is the sentinel
+the driver (cli/train.py) wires in under ``--sdc_check``, in three legs:
+
+1. **In-jit integrity digests** (:func:`tree_fold_metrics`): a cheap,
+   deterministic, *sharding-layout-invariant* tree digest — every leaf
+   bitcast to uint32 words and folded with wraparound addition mod 2^32
+   (commutative + associative, so the fold is bitwise identical no matter
+   how the elements are sharded, restacked across pipeline stages, or
+   reduced), plus an fp32 sum-of-squares for telemetry trend lines (floats
+   do NOT sum order-invariantly; only the integer fold is compared
+   exactly). Inside an auto-GSPMD jit the sums are global (the partitioner
+   inserts the exact all-reduce); inside a ``shard_map`` manual region they
+   are per-shard, which is exactly what the voting leg wants.
+   :func:`host_tree_fold` is the numpy twin — the same mod-2^32 fold
+   computed host-side, bitwise equal to the device fold.
+
+2. **Cross-replica voting** (:func:`make_vote_digest_fn` +
+   :class:`VoteLadder`): pure-dp layouts hold a full parameter replica per
+   device — redundancy the runtime gets for free. A ``shard_map`` manual
+   over the dp axes digests each device's *input-param* replica
+   independently; a device whose memory or ALU lies shows a divergent
+   digest and is *localized*, not just detected. The step freezes
+   params/opt_state in-jit on any disagreement (the AnomalyGuard keep-old
+   select machinery), so a lying replica cannot leak into the psummed
+   update; the driver repairs from a healthy replica
+   (:func:`repair_from_replica`), re-executes the step, and escalates a
+   persistently-striking device through :class:`VoteLadder` into a
+   quarantine verdict that ``MeshHealthMonitor`` turns into the existing
+   ``--migrate_on_degrade`` path — re-search + in-memory relayout, no
+   checkpoint round-trip.
+
+3. **Digest continuity across state motion**
+   (:func:`assert_digest_continuity`): ``elastic.migrate``, cross-layout
+   ``load_checkpoint(target=)``, and serve param migration are all
+   value-preserving by contract; because the fold is layout-invariant it
+   can be asserted unchanged end-to-end across any relayout, refusing with
+   GLS016 instead of silently garbling state.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+SDC_MODES = ("off", "digest", "vote")
+
+_MASK32 = (1 << 32) - 1
+
+
+# ------------------------------------------------------------ device digests
+def _leaf_bits_u32(x) -> jnp.ndarray:
+    """`x` reinterpreted as uint32 words (8-byte dtypes become two words per
+    element via a trailing dim; sub-32-bit dtypes zero-extend)."""
+    x = jnp.asarray(x)
+    if x.dtype == jnp.bool_:
+        x = x.astype(jnp.uint8)
+    nbits = x.dtype.itemsize * 8
+    if nbits > 32:
+        return jax.lax.bitcast_convert_type(x, jnp.uint32)
+    bits = jax.lax.bitcast_convert_type(x, jnp.dtype("uint%d" % nbits))
+    return bits.astype(jnp.uint32)
+
+
+def tree_fold_metrics(tree) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(fold, sumsq) integrity digest of a pytree, traceable inside jit.
+
+    ``fold`` (uint32) is the wraparound sum of every leaf's uint32 bit
+    words — exact, deterministic, and invariant to element order, sharding
+    layout, and layers<->stages restacking, so the same state yields the
+    same fold under any strategy. ``sumsq`` (float32) is the sum of squares
+    of the float leaves — a cheap magnitude trend for telemetry, NOT
+    order-exact; comparisons use ``fold`` only.
+    """
+    fold = jnp.uint32(0)
+    sumsq = jnp.float32(0.0)
+    for leaf in jax.tree.leaves(tree):
+        arr = jnp.asarray(leaf)
+        if not arr.size:
+            continue
+        fold = fold + jnp.sum(_leaf_bits_u32(arr), dtype=jnp.uint32)
+        if jnp.issubdtype(arr.dtype, jnp.floating):
+            sumsq = sumsq + jnp.sum(jnp.square(arr.astype(jnp.float32)))
+    return fold, sumsq
+
+
+def host_tree_fold(tree) -> int:
+    """Numpy twin of :func:`tree_fold_metrics`'s fold: the same mod-2^32
+    word sum computed host-side (pulls device arrays to host — gate usage
+    behind ``--sdc_check``). Bitwise equal to the in-jit fold because
+    addition mod 2^32 is exact in any order; overflowing the uint64
+    accumulator is harmless since 2^32 divides 2^64."""
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        a = np.asarray(leaf)
+        if a.dtype == np.bool_:
+            a = a.astype(np.uint8)
+        if not a.size:
+            continue
+        width = min(a.dtype.itemsize * 8, 32)
+        words = np.ascontiguousarray(a).reshape(-1).view(np.dtype("uint%d" % width))
+        total = (total + int(words.sum(dtype=np.uint64))) & _MASK32
+    return total
+
+
+# ----------------------------------------------------------- replica voting
+def vote_reason(hp) -> Optional[str]:
+    """None when per-replica voting is expressible for this strategy, else
+    the reason it is not. Voting digests each device's full parameter
+    replica under a shard_map manual over the dp axes — the same platform
+    envelope as the quantized-collectives path: every non-dp form of
+    parallelism must be off (a sharded replica is not a replica), and the
+    optimizer state must be dp-replicated too so a lying device can be
+    repaired from any healthy peer. strategy_lint mirrors this as a GLS103
+    downgrade warning; the train driver falls back to digest mode."""
+    if hp.pp > 1:
+        return ("pp=%d: pipeline stages hold disjoint layer shards, not "
+                "full replicas" % hp.pp)
+    for i, s in enumerate(hp.layers):
+        if s.tp > 1 or s.cp > 1 or s.sp:
+            return ("layer %d: tp=%d cp=%d sp=%d shard the parameters; "
+                    "voting needs a full per-device replica (pure-dp "
+                    "layout)" % (i, s.tp, s.cp, int(s.sp)))
+        if s.fsdp:
+            return ("layer %d: fsdp=1 (ZeRO-3) shards parameters over dp; "
+                    "there is no per-device replica to vote on" % i)
+    if hp.vocab_tp > 1 or hp.vocab_cp > 1 or getattr(hp, "embed_sdp", 0):
+        return ("embed/head sharding (vtp=%d vcp=%d embed_sdp=%d) leaves "
+                "no full per-device replica"
+                % (hp.vocab_tp, hp.vocab_cp, int(getattr(hp, "embed_sdp", 0))))
+    if getattr(hp, "default_dp_type", "ddp") != "ddp":
+        return ("default_dp_type=%r shards optimizer state over dp; replica "
+                "repair needs dp-replicated state" % hp.default_dp_type)
+    if hp.dp(0) < 2:
+        return "dp=1: voting needs at least two data-parallel replicas"
+    return None
+
+
+def vote_supported(model) -> Tuple[bool, Optional[str]]:
+    """(ok, reason) for an already-built HybridParallelModel."""
+    reason = vote_reason(model.hp)
+    return reason is None, reason
+
+
+def dp_axes_of(model) -> Tuple[str, ...]:
+    from galvatron_tpu.parallel.mesh import layer_axes
+
+    return tuple(layer_axes(model.hp, 0).dp)
+
+
+def make_vote_digest_fn(model):
+    """``params -> uint32[dp_sizes...]``: each device's digest of its own
+    parameter replica, computed under a ``shard_map`` manual over the dp
+    mesh axes (every other axis has size 1 under :func:`vote_reason`'s
+    envelope — the quant_collectives partial-manual pattern, which legacy
+    shard_map compiles). The output's flat order matches
+    :func:`vote_device_ids`."""
+    dp_axes = dp_axes_of(model)
+    mesh, p_specs = model.mesh, model.param_specs
+
+    def body(params_loc):
+        fold, _ = tree_fold_metrics(params_loc)
+        return fold.reshape((1,) * len(dp_axes))
+
+    def vote(params):
+        return jax.shard_map(
+            body, mesh=mesh, in_specs=(p_specs,),
+            out_specs=P(*dp_axes), axis_names=set(dp_axes),
+        )(params)
+
+    return vote
+
+
+def vote_device_ids(mesh, dp_axes: Sequence[str]) -> List[int]:
+    """Device id behind each flat vote index: the mesh device grid
+    transposed so the dp axes come first (in ``dp_axes`` order), then
+    flattened C-order — the same order ``out_specs=P(*dp_axes)``
+    concatenates per-device outputs in."""
+    names = list(mesh.axis_names)
+    order = [names.index(a) for a in dp_axes] + [
+        i for i, a in enumerate(names) if a not in dp_axes
+    ]
+    grid = np.transpose(mesh.devices, order)
+    n = int(np.prod([mesh.shape[a] for a in dp_axes]))
+    return [int(d.id) for d in grid.reshape(n, -1)[:, 0]]
+
+
+@dataclass
+class VoteLadder:
+    """Host-side strike ladder over per-replica digest votes.
+
+    One :meth:`observe` per drained vote round. A unanimous round resets
+    the ladder. A round with a strict-majority digest localizes the
+    dissenting device(s); each consecutive localization strikes them, and
+    ``strikes`` consecutive strikes escalate to a ``quarantine`` action. A
+    tied round (e.g. dp=2 disagreeing 1-1) is a detection without a
+    culprit: re-execute, never quarantine."""
+
+    strikes: int = 2
+    _consecutive: Dict[int, int] = field(default_factory=dict, repr=False)
+
+    def observe(self, folds: Sequence[int], device_ids: Sequence[int]) -> Dict[str, Any]:
+        folds = [int(f) for f in folds]
+        ids = [int(i) for i in device_ids]
+        counts: Dict[int, int] = {}
+        for f in folds:
+            counts[f] = counts.get(f, 0) + 1
+        majority_fold, majority_n = max(counts.items(), key=lambda kv: kv[1])
+        if len(counts) == 1:
+            self._consecutive.clear()
+            return {"ok": True, "action": "none", "suspects": [],
+                    "quarantine": [], "strikes": {}}
+        if majority_n * 2 <= len(folds):
+            # no strict majority: detected, not localizable
+            return {"ok": False, "action": "reexecute", "suspects": [],
+                    "quarantine": [], "strikes": dict(self._consecutive)}
+        suspects = [i for i, f in zip(ids, folds) if f != majority_fold]
+        for d in list(self._consecutive):
+            if d not in suspects:
+                del self._consecutive[d]
+        for d in suspects:
+            self._consecutive[d] = self._consecutive.get(d, 0) + 1
+        quarantine = [d for d in suspects if self._consecutive[d] >= self.strikes]
+        return {
+            "ok": False,
+            "action": "quarantine" if quarantine else "reexecute",
+            "suspects": suspects,
+            "quarantine": quarantine,
+            "strikes": dict(self._consecutive),
+            "majority_fold": majority_fold,
+        }
+
+    def reset(self) -> None:
+        self._consecutive.clear()
+
+
+def repair_from_replica(tree, bad_device_ids: Sequence[int]):
+    """Rebuild every leaf of a dp-replicated tree from a replica held by a
+    device NOT in ``bad_device_ids``. Under :func:`vote_reason`'s envelope
+    every addressable shard is the full global value, so one healthy
+    shard's bytes re-placed under the leaf's own sharding restores
+    agreement across all replicas — including the lying device's."""
+    bad = {int(i) for i in bad_device_ids}
+
+    def fix(leaf):
+        shards = getattr(leaf, "addressable_shards", None)
+        if not shards:
+            return leaf
+        healthy = [s for s in shards if int(s.device.id) not in bad]
+        src = healthy[0] if healthy else shards[0]
+        return jax.device_put(np.asarray(src.data), leaf.sharding)
+
+    return jax.tree.map(fix, tree)
+
+
+# ------------------------------------------------------- digest continuity
+def assert_digest_continuity(before_fold: int, tree, where: str,
+                             iteration: Optional[int] = None) -> int:
+    """Assert `tree`'s layout-invariant fold still equals ``before_fold``
+    after a supposedly value-preserving state motion (relayout, migrate,
+    cross-layout restore). Raises a GLS016 DiagnosticError on mismatch —
+    refusing garbled state beats training on it. Returns the fold and emits
+    an ``sdc_check mode="continuity"`` event on success."""
+    after = host_tree_fold(tree)
+    if int(after) != int(before_fold) & _MASK32:
+        from galvatron_tpu.analysis import diagnostics as D
+
+        raise D.DiagnosticError([D.make(
+            "GLS016",
+            "%s: layout-invariant digest changed 0x%08x -> 0x%08x; the "
+            "state motion was not value-preserving — refusing to continue "
+            "on garbled state" % (where, int(before_fold) & _MASK32, after),
+        )])
+    from galvatron_tpu.obs import telemetry
+
+    telemetry.emit("sdc_check", mode="continuity", where=where,
+                   iter=iteration, fold=int(after))
+    return after

@@ -23,6 +23,12 @@ Scenarios (``python -m tests.runtime.fault_injection --scenario ...``):
                    the run for --hang_s seconds; the watchdog (armed via
                    --watchdog_floor/--watchdog_factor) must fire, escalate,
                    emergency-save, and exit with WATCHDOG_EXIT_CODE (3)
+    bitflip        one device's parameter replica gets a bit flipped before
+                   the --flip_at-th step call (--flip_device, and every call
+                   after that with --flip_persistent 1): the silent-corruption
+                   sentinel (--sdc_check vote) must out-vote the lying
+                   replica, repair + re-execute, and — when the flips keep
+                   coming — quarantine the device and migrate off it
 
 Serve scenarios (same entry point; they drive ``cli serve`` instead of the
 training loop and print ``SERVE=<json>`` for the subprocess tests):
@@ -146,6 +152,74 @@ def hang_hooks(at_step: int, hang_s: float):
                 _time.sleep(hang_s)
             state["calls"] += 1
             return out
+
+        return wrapped
+
+    return FaultHooks(wrap_step_fn=wrap)
+
+
+def bitflip_hooks(at_step: int, device_id: int, persistent: bool = False):
+    """FaultHooks flipping one mantissa bit in `device_id`'s copy of the
+    first parameter leaf right before the `at_step`-th step call — the
+    deterministic stand-in for a device computing/holding wrong values
+    without any fault signal (true SDC). `persistent` re-flips on every
+    later call too, like a chip with a stuck datapath, and stands down only
+    once the device no longer appears in the parameters' sharding (i.e. the
+    quarantine + migration actually moved the state off it)."""
+    from galvatron_tpu.runtime.resilience import FaultHooks
+
+    state = {"calls": 0, "done": False}
+
+    def corrupt(tree):
+        import jax
+
+        leaves, treedef = jax.tree.flatten(tree)
+        for i, x in enumerate(leaves):
+            if not hasattr(x, "addressable_shards") or x.dtype != np.float32:
+                continue
+            devs = {int(d.id): d for d in x.sharding.device_set}
+            if device_id not in devs:
+                return None  # the lying device left the mesh: stand down
+            datas = {s.device: np.array(s.data) for s in x.addressable_shards}
+            target = devs[device_id]
+            if target not in datas or datas[target].size == 0:
+                continue
+            words = datas[target].reshape(-1).view(np.uint32)
+            if persistent:
+                # stuck-at-1 semantics: monotone OR over a mantissa-bit
+                # ladder. XOR would be self-inverting — re-applied to the
+                # frozen (still-corrupt) carry of an in-flight step it would
+                # RESTORE the healthy value and let that step slip past the
+                # vote, which no stuck datapath ever does.
+                for b in (18, 19, 20, 21, 22):
+                    if not (int(words[0]) >> b) & 1:
+                        words[0] |= np.uint32(1 << b)
+                        break
+                else:  # pathological: all ladder bits set — clear one
+                    words[0] &= np.uint32(~(1 << 18) & 0xFFFFFFFF)
+            else:
+                words[0] ^= np.uint32(1 << 18)
+            leaves[i] = jax.make_array_from_single_device_arrays(
+                x.shape, x.sharding,
+                [jax.device_put(datas[d], d)
+                 for d in sorted(datas, key=lambda d: d.id)])
+            return jax.tree.unflatten(treedef, leaves)
+        return None
+
+    def wrap(step_fn):
+        def wrapped(params, *rest):
+            call = state["calls"]
+            state["calls"] += 1
+            fire = (call >= at_step) if persistent else (call == at_step)
+            if fire and not state["done"]:
+                flipped = corrupt(params)
+                if flipped is not None:
+                    params = flipped
+                    if not persistent:
+                        state["done"] = True
+                elif persistent:
+                    state["done"] = True  # migrated off the device: healthy now
+            return step_fn(params, *rest)
 
         return wrapped
 
@@ -385,7 +459,7 @@ def main(argv=None):
     p = argparse.ArgumentParser("fault_injection")
     p.add_argument("--scenario", required=True,
                    choices=("train", "resume", "kill_mid_save", "sigterm",
-                            "hang") + SERVE_SCENARIOS)
+                            "hang", "bitflip") + SERVE_SCENARIOS)
     p.add_argument("--save", default=None)
     p.add_argument("--load", default=None)
     p.add_argument("--iters", type=int, default=6)
@@ -404,10 +478,22 @@ def main(argv=None):
     p.add_argument("--world", type=int, default=1)
     p.add_argument("--elastic", default=None, choices=(None, "resume", "search"),
                    help="forwarded as --elastic for the resume scenario")
+    # bitflip (silent-corruption) knobs
+    p.add_argument("--flip_at", type=int, default=3,
+                   help="step call whose input params get the bit flip")
+    p.add_argument("--flip_device", type=int, default=2,
+                   help="device id whose parameter replica lies")
+    p.add_argument("--flip_persistent", type=int, default=0,
+                   help="1: keep flipping every call until the device is "
+                        "migrated away (exercises quarantine + migration)")
+    p.add_argument("--sdc_check", default="vote",
+                   help="forwarded as --sdc_check for the bitflip scenario")
+    p.add_argument("--sdc_strikes", type=int, default=2,
+                   help="forwarded as --sdc_strikes for the bitflip scenario")
     # serve-scenario knobs
     p.add_argument("--num_requests", type=int, default=12)
     p.add_argument("--telemetry", default=None,
-                   help="forwarded as --telemetry (serve scenarios)")
+                   help="forwarded as --telemetry (train + serve scenarios)")
     p.add_argument("--p99_ttft_ms", type=float, default=0.0,
                    help="forwarded as --p99_ttft_ms (serve_overload)")
     p.add_argument("--tick_ms", type=float, default=0.0,
@@ -440,6 +526,16 @@ def main(argv=None):
     if a.watchdog_floor:
         extra += ["--watchdog", str(a.watchdog_floor),
                   "--watchdog_factor", str(a.watchdog_factor)]
+    if a.scenario == "bitflip":
+        # pure-dp world: the global batch must tile the dp degree (and keep
+        # doing so after a quarantine shrinks the world, hence max not ==)
+        extra += ["--global_train_batch_size", str(max(2, a.world)),
+                  "--sdc_check", a.sdc_check,
+                  "--sdc_strikes", str(a.sdc_strikes)]
+        if a.flip_persistent:
+            extra += ["--migrate_on_degrade", "1"]
+    if a.telemetry:
+        extra += ["--telemetry", a.telemetry]
     args = initialize_galvatron(mode="train_dist", argv=tiny_argv(
         a.iters, save=a.save, load=a.load, save_interval=a.save_interval,
         world=a.world, extra=extra))
@@ -449,6 +545,9 @@ def main(argv=None):
         args.fault_hooks = sigterm_hooks(a.sigterm_at)
     elif a.scenario == "hang":
         args.fault_hooks = hang_hooks(a.hang_at, a.hang_s)
+    elif a.scenario == "bitflip":
+        args.fault_hooks = bitflip_hooks(
+            a.flip_at, a.flip_device, persistent=bool(a.flip_persistent))
     try:
         summary = train(args)
     except Exception as e:

@@ -410,4 +410,6 @@ def test_summary_reports_resilience_counters(devices8):
         "anomalies_skipped": 0, "rollbacks": 0, "retries": 0,
         "retries_succeeded": 0, "retries_exhausted": 0,
         "emergency_saves": 0, "torn_checkpoints_skipped": 0,
+        "sdc_checks": 0, "sdc_mismatches": 0, "sdc_reexecutions": 0,
+        "sdc_quarantines": 0,
     }

@@ -1,0 +1,369 @@
+"""Silent-corruption sentinel tests (runtime/sdc.py + driver wiring).
+
+Fast tier-1 coverage: digest determinism and host/device bitwise equality,
+layout invariance of the fold, replica-vote localization + repair on a
+virtual mesh, the VoteLadder strike ladder, digest-continuity (GLS016),
+checkpoint-manifest folds + the --deep GLS214 audit, sentinel lint
+warnings, and driver-level off-vs-digest loss parity.
+
+The subprocess bitflip simulations (transient detect/repair/re-execute,
+persistent quarantine + migration) live at the bottom, marked slow+fault
+like the rest of the fault lane.
+"""
+
+import json
+import os
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from galvatron_tpu.analysis import diagnostics as D
+from galvatron_tpu.config.strategy import HybridParallelConfig
+from galvatron_tpu.runtime import checkpoint as ck
+from galvatron_tpu.runtime import sdc
+
+
+# ------------------------------------------------------------------ digests
+def _mixed_tree():
+    k = jax.random.PRNGKey(0)
+    return {
+        "w": jax.random.normal(k, (33, 5), jnp.float32),
+        "h": jax.random.normal(jax.random.fold_in(k, 1), (7,), jnp.bfloat16),
+        "i": jnp.arange(11, dtype=jnp.int32),
+        "b": jnp.array([True, False, True]),
+        "empty": jnp.zeros((0,), jnp.float32),
+    }
+
+
+def test_fold_host_equals_device_and_is_deterministic():
+    tree = _mixed_tree()
+    fold_jit, sumsq = jax.jit(sdc.tree_fold_metrics)(tree)
+    fold_jit2, _ = jax.jit(sdc.tree_fold_metrics)(tree)
+    assert int(fold_jit) == int(fold_jit2)
+    assert int(fold_jit) == sdc.host_tree_fold(tree)
+    assert np.isfinite(float(sumsq)) and float(sumsq) > 0
+
+
+def test_fold_is_layout_invariant(devices8):
+    x = np.arange(64, dtype=np.float32).reshape(8, 8) * 0.37 + 0.1
+    mesh_a = Mesh(np.array(devices8).reshape(8), ("a",))
+    mesh_b = Mesh(np.array(devices8).reshape(2, 4), ("p", "q"))
+    layouts = [
+        jnp.asarray(x),
+        jax.device_put(x, NamedSharding(mesh_a, P("a"))),
+        jax.device_put(x, NamedSharding(mesh_a, P(None, "a"))),
+        jax.device_put(x, NamedSharding(mesh_b, P("q", "p"))),
+        jax.device_put(x, NamedSharding(mesh_b, P())),
+    ]
+    host = sdc.host_tree_fold({"w": x})
+    for arr in layouts:
+        assert int(jax.jit(sdc.tree_fold_metrics)({"w": arr})[0]) == host
+
+
+def test_fold_detects_single_bitflip():
+    x = np.arange(16, dtype=np.float32)
+    clean = sdc.host_tree_fold(x)
+    flipped = x.copy()
+    flipped.view(np.uint32)[5] ^= np.uint32(1 << 18)
+    assert sdc.host_tree_fold(flipped) != clean
+
+
+# ------------------------------------------------------------- vote envelope
+def test_vote_reason_envelope():
+    ok = HybridParallelConfig.uniform(world_size=4, num_layers=1, tp=1,
+                                      global_bsz=4)
+    assert sdc.vote_reason(ok) is None
+    tp2 = HybridParallelConfig.uniform(world_size=4, num_layers=1, tp=2,
+                                       global_bsz=4)
+    assert "tp=2" in sdc.vote_reason(tp2)
+    solo = HybridParallelConfig.uniform(world_size=1, num_layers=1, tp=1,
+                                        global_bsz=2)
+    assert "dp=1" in sdc.vote_reason(solo)
+
+
+# --------------------------------------------------------------- vote ladder
+def test_vote_ladder_majority_strikes_then_quarantines():
+    lad = sdc.VoteLadder(strikes=2)
+    ids = [0, 1, 2, 3]
+    v1 = lad.observe([5, 5, 7, 5], ids)
+    assert not v1["ok"] and v1["action"] == "reexecute"
+    assert v1["suspects"] == [2] and v1["quarantine"] == []
+    v2 = lad.observe([9, 9, 1, 9], ids)
+    assert v2["action"] == "quarantine" and v2["quarantine"] == [2]
+
+
+def test_vote_ladder_unanimous_round_resets_strikes():
+    lad = sdc.VoteLadder(strikes=2)
+    ids = [0, 1, 2, 3]
+    lad.observe([5, 5, 7, 5], ids)
+    ok = lad.observe([6, 6, 6, 6], ids)
+    assert ok["ok"] and ok["action"] == "none"
+    v = lad.observe([8, 8, 2, 8], ids)  # strike count restarted at 1
+    assert v["action"] == "reexecute" and v["quarantine"] == []
+
+
+def test_vote_ladder_changing_suspect_resets_the_old_one():
+    lad = sdc.VoteLadder(strikes=2)
+    ids = [0, 1, 2, 3]
+    lad.observe([5, 7, 5, 5], ids)
+    v = lad.observe([5, 5, 7, 5], ids)
+    assert v["action"] == "reexecute"
+    assert v["strikes"] == {2: 1}  # device 1's strike evaporated
+
+
+def test_vote_ladder_tie_detects_without_convicting():
+    lad = sdc.VoteLadder(strikes=1)  # even strikes=1 must not convict a tie
+    v = lad.observe([5, 7], [0, 1])
+    assert not v["ok"] and v["action"] == "reexecute"
+    assert v["suspects"] == [] and v["quarantine"] == []
+
+
+# ------------------------------------------- shard_map vote + replica repair
+def _stub_vote_model(devices8, world=4):
+    hp = HybridParallelConfig.uniform(world_size=world, num_layers=1, tp=1,
+                                      global_bsz=world)
+    from galvatron_tpu.parallel.mesh import build_mesh
+
+    mesh = build_mesh(hp, devices8[:world])
+    return SimpleNamespace(hp=hp, mesh=mesh,
+                           param_specs={"w": P(), "b": P()})
+
+
+def _corrupt_replica(params, device_id):
+    """Rebuild `params` with one bit flipped in `device_id`'s replica of w."""
+    out = dict(params)
+    w = params["w"]
+    datas = {s.device: np.array(s.data) for s in w.addressable_shards}
+    target = next(d for d in datas if int(d.id) == device_id)
+    datas[target].reshape(-1).view(np.uint32)[0] ^= np.uint32(1 << 18)
+    out["w"] = jax.make_array_from_single_device_arrays(
+        w.shape, w.sharding,
+        [jax.device_put(datas[d], d)
+         for d in sorted(datas, key=lambda d: d.id)])
+    return out
+
+
+def test_vote_localizes_lying_replica_and_repair_restores(devices8):
+    model = _stub_vote_model(devices8)
+    repl = NamedSharding(model.mesh, P())
+    params = {
+        "w": jax.device_put(np.linspace(0.1, 1.7, 24,
+                                        dtype=np.float32).reshape(6, 4), repl),
+        "b": jax.device_put(np.ones((4,), np.float32), repl),
+    }
+    # legacy shard_map has no eager path; the train step runs it under jit
+    vote = jax.jit(sdc.make_vote_digest_fn(model))
+    ids = sdc.vote_device_ids(model.mesh, sdc.dp_axes_of(model))
+    assert sorted(ids) == [int(d.id) for d in devices8[:4]]
+
+    clean = [int(v) for v in np.asarray(vote(params)).ravel()]
+    assert len(set(clean)) == 1
+    assert clean[0] == sdc.host_tree_fold(params)
+
+    liar = ids[2]
+    votes = [int(v) for v in np.asarray(vote(_corrupt_replica(params, liar))).ravel()]
+    assert votes[2] != clean[0]
+    assert [v for i, v in enumerate(votes) if i != 2] == clean[:3]
+
+    repaired = sdc.repair_from_replica(_corrupt_replica(params, liar), [liar])
+    votes = [int(v) for v in np.asarray(vote(repaired)).ravel()]
+    assert votes == clean
+
+
+# --------------------------------------------------------- digest continuity
+def test_assert_digest_continuity_passes_and_refuses():
+    tree = {"w": jnp.arange(12.0).reshape(3, 4)}
+    fold = sdc.host_tree_fold(tree)
+    assert sdc.assert_digest_continuity(fold, tree, "test(noop)") == fold
+    garbled = {"w": tree["w"].at[1, 1].set(99.0)}
+    with pytest.raises(D.DiagnosticError) as err:
+        sdc.assert_digest_continuity(fold, garbled, "test(garbled)")
+    assert [d.code for d in err.value.diagnostics] == ["GLS016"]
+    assert "test(garbled)" in err.value.diagnostics[0].message
+
+
+def test_load_checkpoint_cross_layout_asserts_continuity(devices8, tmp_path):
+    mesh_a = Mesh(np.array(devices8).reshape(8), ("x",))
+    mesh_b = Mesh(np.array(devices8).reshape(4, 2), ("p", "q"))
+    x = np.arange(32, dtype=np.float32).reshape(8, 4)
+    tree = {"w": jax.device_put(x, NamedSharding(mesh_a, P("x", None)))}
+    d = str(tmp_path / "c")
+    ck.save_checkpoint(d, 0, tree)
+    out, _, _ = ck.load_checkpoint(
+        d, params_target=tree,
+        params_shardings={"w": NamedSharding(mesh_b, P("q", "p"))},
+        sdc_check=True)
+    np.testing.assert_array_equal(np.asarray(out["w"]), x)
+    assert sdc.host_tree_fold(out) == sdc.host_tree_fold({"w": x})
+
+
+# ----------------------------------------------- manifest fold + deep audit
+def test_manifest_records_layout_invariant_fold(tmp_path):
+    tree = {"w": jnp.linspace(0.0, 3.0, 32).reshape(8, 4)}
+    d = str(tmp_path / "c")
+    ck.save_checkpoint(d, 0, tree)
+    rec = ck.read_manifest(d, 0)["items"]["params"]
+    assert rec["fold"] == sdc.host_tree_fold(tree)
+
+
+def _rewrite_manifest(d, step, mutate):
+    from galvatron_tpu.runtime.checkpoint import _manifest_path
+
+    path = _manifest_path(d, step)
+    with open(path) as f:
+        manifest = json.load(f)
+    mutate(manifest)
+    with open(path, "w") as f:
+        json.dump(manifest, f)
+
+
+def test_deep_audit_flags_fold_mismatch_gls214(tmp_path):
+    from galvatron_tpu.analysis.ckpt_lint import audit_checkpoint_dir
+
+    tree = {"w": jnp.linspace(0.0, 3.0, 32).reshape(8, 4)}
+    d = str(tmp_path / "c")
+    ck.save_checkpoint(d, 0, tree)
+    clean = audit_checkpoint_dir(d, deep=True)
+    assert not [x for x in clean.diagnostics if x.code == "GLS214"]
+
+    _rewrite_manifest(d, 0, lambda m: m["items"]["params"].update(
+        fold=(m["items"]["params"]["fold"] + 1) & 0xFFFFFFFF))
+    tampered = audit_checkpoint_dir(d, deep=True)
+    codes = [x.code for x in tampered.diagnostics]
+    assert "GLS214" in codes
+    # without --deep the host-only audit must stay silent about values
+    assert "GLS214" not in [
+        x.code for x in audit_checkpoint_dir(d, deep=False).diagnostics]
+
+
+def test_deep_audit_warns_on_pre_fold_manifest(tmp_path):
+    from galvatron_tpu.analysis.ckpt_lint import audit_checkpoint_dir
+
+    d = str(tmp_path / "c")
+    ck.save_checkpoint(d, 0, {"w": jnp.ones((4,))})
+
+    def drop_fold(m):
+        for rec in m["items"].values():
+            rec.pop("fold", None)
+
+    _rewrite_manifest(d, 0, drop_fold)
+    report = audit_checkpoint_dir(d, deep=True)
+    warn = [x for x in report.diagnostics if x.code == "GLS213"]
+    assert any("predates the integrity fold" in x.message for x in warn)
+    assert report.exit_code() == 0  # warning, not error: old ckpts stay usable
+
+
+# ------------------------------------------------------------ sentinel lint
+def test_strategy_lint_warns_on_inert_or_downgraded_sentinel():
+    from galvatron_tpu.analysis.strategy_lint import lint_hp
+
+    tp2 = HybridParallelConfig.uniform(world_size=4, num_layers=1, tp=2,
+                                       global_bsz=4)
+    msgs = [x.message for x in lint_hp(tp2, sdc_check="vote").diagnostics
+            if x.code == "GLS103"]
+    assert any("downgrades to digest" in m for m in msgs)
+
+    pure = HybridParallelConfig.uniform(world_size=4, num_layers=1, tp=1,
+                                        global_bsz=4)
+    assert not [x for x in lint_hp(pure, sdc_check="vote").diagnostics
+                if x.code == "GLS103" and "sdc" in x.message]
+    inert = [x.message for x in
+             lint_hp(pure, sdc_check="off", sdc_interval=10).diagnostics
+             if x.code == "GLS103"]
+    assert any("sdc_interval is inert" in m for m in inert)
+
+
+# ----------------------------------------------------- driver-level parity
+TINY8 = [
+    "--model_type", "llama", "--set_model_config_manually", "1",
+    "--hidden_size", "32", "--num_attention_heads", "2", "--num_layers", "1",
+    "--vocab_size", "64", "--seq_length", "16", "--mixed_precision", "fp32",
+    "--global_train_batch_size", "8", "--lr", "1e-2", "--world_size", "8",
+    "--train_iters", "3",
+]
+
+
+def _run_driver(extra):
+    from galvatron_tpu.cli.arguments import initialize_galvatron
+    from galvatron_tpu.cli.train import train
+
+    return train(initialize_galvatron(mode="train_dist", argv=TINY8 + extra))
+
+
+def test_driver_digest_mode_is_bitwise_transparent(devices8):
+    """--sdc_check digest must not perturb the trajectory: the digest is a
+    side-output of the same compiled step, so losses match the sentinel-off
+    run bit for bit (the vote mode's shard_map region legally shifts GSPMD
+    partitioning decisions and only promises same-mode determinism)."""
+    off = _run_driver([])
+    dig = _run_driver(["--sdc_check", "digest", "--sdc_interval", "2"])
+    assert dig["losses"] == off["losses"]  # exact float equality, no allclose
+    assert off["resilience"]["sdc_checks"] == 0
+    # interval 2 over iters 0,1,2 -> heartbeats at 0 and 2
+    assert dig["resilience"]["sdc_checks"] == 2
+
+
+# ------------------------------------------------- subprocess bitflip sims
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _run_sim(*argv, timeout=600):
+    from tests.runtime.test_fault_injection import parse, run_scenario
+
+    proc = run_scenario(*argv, timeout=timeout)
+    return (parse(proc.stdout, "LOSSES"), parse(proc.stdout, "RESILIENCE"))
+
+
+@pytest.mark.slow
+@pytest.mark.fault
+def test_bitflip_transient_detect_repair_reexecute_bitwise():
+    """One bit flipped in one device's replica at step 2: the vote names
+    device 2, the driver repairs from a healthy replica and re-executes,
+    and the finished trajectory is bitwise identical to a clean run of the
+    same mode (same-mode is the contract: see make_train_step's docstring)."""
+    common = ("--scenario", "bitflip", "--iters", "5", "--world", "4",
+              "--devices", "4")
+    clean_losses, clean_res = _run_sim(*common, "--flip_at", "999")
+    losses, res = _run_sim(*common, "--flip_at", "2", "--flip_device", "2")
+    assert losses == clean_losses  # exact: repair + re-execution, no drift
+    assert clean_res["sdc_mismatches"] == 0
+    assert res["sdc_mismatches"] == 1 and res["sdc_reexecutions"] == 1
+    assert res["sdc_quarantines"] == 0
+
+
+@pytest.mark.slow
+@pytest.mark.fault
+def test_bitflip_persistent_quarantines_device_and_migrates(tmp_path):
+    """A stuck bit on device 2 from step 2 on: two consecutive strikes
+    convict it, the driver quarantines + live-migrates off it (4 -> 2; 3
+    devices can't tile the strategy), and the run completes with losses
+    inside the elastic-migration tolerance of a clean same-mode run."""
+    tel = str(tmp_path / "tel.jsonl")
+    common = ("--scenario", "bitflip", "--iters", "6", "--world", "4",
+              "--devices", "4")
+    clean_losses, _ = _run_sim(*common, "--flip_at", "999")
+    losses, res = _run_sim(
+        *common, "--flip_at", "2", "--flip_device", "2",
+        "--flip_persistent", "1", "--telemetry", tel)
+    assert res["sdc_quarantines"] == 1
+    assert res["sdc_mismatches"] == 2  # strike 1 re-executed, strike 2 convicted
+    np.testing.assert_allclose(losses, clean_losses, rtol=5e-3, atol=2e-4)
+
+    with open(tel) as f:
+        events = [json.loads(line) for line in f]
+    quars = [e for e in events if e["type"] == "sdc_quarantine"]
+    assert [e["device_ids"] for e in quars] == [[2]]  # the liar is NAMED
+    migs = [e for e in events if e["type"] == "elastic"
+            and e.get("action") == "migrate"]
+    assert len(migs) == 1 and migs[0]["reason"] == "sdc_quarantine"
+    assert migs[0]["live_world"] == 2
+    # continuity asserts covered the relayout (mode="continuity" heartbeats)
+    conts = [e for e in events if e["type"] == "sdc_check"
+             and e.get("mode") == "continuity"]
+    assert {e["where"] for e in conts} >= {"migrate(params)",
+                                           "migrate(opt_state)"}

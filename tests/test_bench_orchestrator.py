@@ -77,13 +77,16 @@ def test_mfu_regression_gate_exit_codes(tmp_path):
             "serve": {"gspmd": {"tokens_per_s_per_chip": 60.0},
                       "searched": {"tokens_per_s_per_chip": 64.0,
                                    "decode_step_ms": 2.0,
-                                   "ttft_ms_p99": 240.0}}}}}
+                                   "ttft_ms_p99": 240.0}},
+            "sdc_overhead": {"off": {"step_ms": 8.0},
+                             "digest": {"step_ms": 8.1},
+                             "vote": {"step_ms": 9.0}}}}}
     empty_round = {"n": 4, "parsed": None}  # wedged round: tolerated, skipped
     (tmp_path / "BENCH_r03.json").write_text(json.dumps(baseline))
     (tmp_path / "BENCH_r04.json").write_text(json.dumps(empty_round))
 
     def run_gate(mfu, gate="1", overlap_step_ms=9.0, quant_step_ms=22.0,
-                 serve_tps=64.0, serve_step_ms=2.0):
+                 serve_tps=64.0, serve_step_ms=2.0, sdc_digest_step_ms=8.1):
         fake = tmp_path / "fake.json"
         fake.write_text(json.dumps({"results": {
             "train_step": {"mfu": mfu, "tokens_per_sec_per_chip": 30000.0},
@@ -95,7 +98,10 @@ def test_mfu_regression_gate_exit_codes(tmp_path):
             "serve": {"gspmd": {"tokens_per_s_per_chip": 60.0},
                       "searched": {"tokens_per_s_per_chip": serve_tps,
                                    "decode_step_ms": serve_step_ms,
-                                   "ttft_ms_p99": 240.0}}}}))
+                                   "ttft_ms_p99": 240.0}},
+            "sdc_overhead": {"off": {"step_ms": 8.0},
+                             "digest": {"step_ms": sdc_digest_step_ms},
+                             "vote": {"step_ms": 9.0}}}}))
         env = dict(os.environ,
                    GALVATRON_BENCH_FAKE_RESULTS=str(fake),
                    GALVATRON_BENCH_GATE=gate,
@@ -126,6 +132,11 @@ def test_mfu_regression_gate_exit_codes(tmp_path):
     p = run_gate(0.4, serve_step_ms=3.0)
     assert p.returncode == 1, p.stdout
     assert "serve.searched.decode_step_ms" in p.stdout
+    # the sentinel's step cost is gated too (ISSUE 13): a digest-mode step
+    # that outgrows its <= 2% budget regresses even with MFU healthy
+    p = run_gate(0.4, sdc_digest_step_ms=10.0)
+    assert p.returncode == 1, p.stdout
+    assert "sdc_overhead.digest.step_ms" in p.stdout
     p = run_gate(0.2, gate="")  # gate off: wedge-proofing contract holds
     assert p.returncode == 0 and "MFU-REGRESSION" not in p.stdout
     # no usable baseline at all: tolerated
