@@ -201,6 +201,12 @@ GATE_METRICS = (
     ("extra.sdc_overhead.off.step_ms", False),
     ("extra.sdc_overhead.digest.step_ms", False),
     ("extra.sdc_overhead.vote.step_ms", False),
+    # Online autotuner (ISSUE 14): the gate pins throughput on both sides
+    # of the mid-run hot-swap — the mis-specified start (detector + planner
+    # riding along) and the converged post-swap strategy — so neither the
+    # tuner's overhead nor the swapped-to layout can silently decay
+    ("extra.autotune.misspecified.steps_per_s", True),
+    ("extra.autotune.converged.steps_per_s", True),
 )
 
 

@@ -913,6 +913,108 @@ def section_sdc_overhead():
     return out
 
 
+def section_autotune():
+    """Online autotuner (ISSUE 14): the shipped cli/train loop on the
+    4-virtual-device CPU config started from a deliberately mis-specified
+    strategy — needless activation checkpointing on a model that fits
+    without it. The autotuner detects steady state, calibrates the cost
+    model on the measured step time, re-searches under the original memory
+    budget, and hot-swaps to the checkpoint-off winner mid-run. heads=1
+    caps the searched tp at 1, so the winner differs from the start only
+    by dropping the recompute — a change that is faster in wall clock on
+    this host too, which makes steps/s before vs after the swap a
+    meaningful number here (unlike layout-only swaps, whose CPU timing is
+    virtual-device noise). Layers are unrolled (--no_scan_layers): under
+    scan, XLA:CPU prices the non-checkpointed path's stacked activation
+    storage above the recompute it saves, inverting the tradeoff the
+    tuner is being measured on. The no-op leg re-runs FROM the winner: the
+    planner must fire and refuse to swap (hysteresis), pinning the
+    convergence contract alongside the two gated steps/s numbers."""
+    import statistics
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from galvatron_tpu.cli.arguments import initialize_galvatron
+    from galvatron_tpu.cli.train import train
+    from galvatron_tpu.config.strategy import HybridParallelConfig
+
+    tmp = tempfile.mkdtemp(prefix="galv_bench_autotune_")
+    start = os.path.join(tmp, "ckpt_on.json")
+    HybridParallelConfig.uniform(
+        world_size=4, num_layers=2, pp=1, tp=1, checkpoint=1, global_bsz=8,
+    ).save(start)
+
+    def run(tag, iters, config_path):
+        tele = os.path.join(tmp, tag + ".jsonl")
+        argv = [
+            "--model_type", "gpt", "--set_model_config_manually", "1",
+            "--hidden_size", "64", "--num_attention_heads", "1",
+            "--num_layers", "2", "--vocab_size", "256", "--seq_length", "64",
+            "--mixed_precision", "fp32", "--global_train_batch_size", "8",
+            "--train_iters", str(iters), "--world_size", "4",
+            "--log_interval", "1000", "--lr", "1e-3", "--no_scan_layers",
+            "--autotune", "apply", "--galvatron_config_path", config_path,
+            "--telemetry", tele,
+        ]
+        args = initialize_galvatron(mode="train_dist", argv=argv)
+        args.autotune_window = 3  # settle inside the short bench run
+        s = train(args)
+        with open(tele) as f:
+            events = [json.loads(line) for line in f]
+        return s, events
+
+    iters = 8 if SMOKE else 16
+    s, events = run("misspec", iters, start)
+    plans = [e for e in events
+             if e["type"] == "autotune" and e.get("action") == "plan"]
+    swapped = [e for e in plans if e.get("swapped")]
+    steps = {e["iter"]: e["iter_ms"] for e in events
+             if e["type"] == "step" and e.get("iter_ms") is not None}
+    out = {"world": 4, "train_iters": iters,
+           "plans": len(plans), "swaps": len(swapped)}
+    if swapped:
+        sw = swapped[0]
+        si = sw.get("iter") or 0
+        out["swap_iter"] = si
+        out["predicted_saving_ms"] = round(
+            sw.get("predicted_saving_ms") or 0.0, 3)
+        out["winner_checkpoint"] = (sw.get("to_strategy") or {}).get("checkpoint")
+        # iters 0-1 are warmup/compile; swap_iter+1 funds the winner's
+        # recompile — both excluded, same split the tuner itself uses
+        pre = [ms for it, ms in steps.items() if 2 <= it < si]
+        post = [ms for it, ms in steps.items() if it > si + 1]
+        if pre:
+            m = statistics.median(pre)
+            out["misspecified"] = {
+                "step_ms": round(m, 3), "steps_per_s": round(1000.0 / m, 3)}
+        if post:
+            m = statistics.median(post)
+            out["converged"] = {
+                "step_ms": round(m, 3), "steps_per_s": round(1000.0 / m, 3)}
+        realized = [e for e in events
+                    if e["type"] == "autotune" and e.get("action") == "realized"]
+        if realized:
+            out["realized_saving_ms"] = round(
+                realized[-1].get("realized_saving_ms") or 0.0, 3)
+        # no-op leg: restart from the searched winner — the planner must
+        # refuse to swap (zero plans would mean the detector never settled;
+        # a swap would mean the hysteresis contract broke)
+        winner = os.path.join(tmp, "winner.json")
+        with open(winner, "w") as f:
+            json.dump(sw["to_strategy"], f)
+        s2, ev2 = run("noop", 6 if SMOKE else 10, winner)
+        noop_plans = [e for e in ev2
+                      if e["type"] == "autotune" and e.get("action") == "plan"]
+        out["noop"] = {
+            "plans": len(noop_plans),
+            "swaps": sum(1 for e in noop_plans if e.get("swapped")),
+        }
+    return out
+
+
 SECTIONS = {
     "layer_fwd": section_layer_fwd,
     "train_step": section_train_step,
@@ -924,6 +1026,7 @@ SECTIONS = {
     "serve": section_serve,
     "serve_degraded": section_serve_degraded,
     "sdc_overhead": section_sdc_overhead,
+    "autotune": section_autotune,
 }
 
 
@@ -940,7 +1043,8 @@ DEADLINE_S = float(os.environ.get("GALVATRON_BENCH_DEADLINE", "200" if SMOKE els
 SECTION_BUDGETS = {"layer_fwd": 300.0, "train_step": 360.0, "breakdown": 200.0,
                    "masked_flash": 180.0, "train_loop": 200.0,
                    "tp_overlap": 200.0, "quant_comm": 200.0, "serve": 200.0,
-                   "serve_degraded": 200.0, "sdc_overhead": 200.0}
+                   "serve_degraded": 200.0, "sdc_overhead": 200.0,
+                   "autotune": 200.0}
 _START = time.time()
 _ACTIVE_CHILD = None  # Popen of the in-flight section, for watchdog cleanup
 
@@ -1026,6 +1130,8 @@ def main():
             extra["serve_degraded"] = results["serve_degraded"]
         if results.get("sdc_overhead"):
             extra["sdc_overhead"] = results["sdc_overhead"]
+        if results.get("autotune"):
+            extra["autotune"] = results["autotune"]
         if timing_hazards:
             extra["timing_hazard"] = timing_hazards
         if errors:
@@ -1146,6 +1252,12 @@ def main():
         }, reserve_s=floor)
     results["sdc_overhead"] = _run_section(
         "sdc_overhead", errors, extra_env={
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "")
+                          + " --xla_force_host_platform_device_count=4").strip(),
+        }, reserve_s=floor)
+    results["autotune"] = _run_section(
+        "autotune", errors, extra_env={
             "JAX_PLATFORMS": "cpu",
             "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "")
                           + " --xla_force_host_platform_device_count=4").strip(),

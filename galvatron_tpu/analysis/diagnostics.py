@@ -47,6 +47,7 @@ CODES: Dict[str, Tuple[str, str]] = {
     "GLS014": (ERROR, "serve-infeasible configuration (latency bound, KV budget, or layout)"),
     "GLS015": (ERROR, "serve world infeasible after mesh degradation"),
     "GLS016": (ERROR, "state motion changed the layout-invariant integrity digest"),
+    "GLS017": (ERROR, "online autotuner fighting a pinned strategy"),
     # ---- strategy linter (GLS1xx cost-model-backed warnings) ----
     "GLS101": (WARNING, "estimated per-device memory exceeds the HBM budget"),
     "GLS102": (WARNING, "expensive cross-layer redistribution between adjacent layers"),

@@ -483,6 +483,9 @@ def lint_hp(
     mode: Optional[str] = None,
     sdc_check: Optional[str] = None,
     sdc_interval: Optional[int] = None,
+    autotune: Optional[str] = None,
+    autotune_margin: Optional[float] = None,
+    elastic_strategy: Optional[str] = None,
 ) -> D.DiagnosticReport:
     """Lint an already-constructed config (the train-driver / search-engine
     hook): engine-consistency + model-aware checks + cost warnings. The
@@ -497,7 +500,12 @@ def lint_hp(
     silent-corruption sentinel flags: voting on a layout with no per-device
     replica (runtime/sdc.vote_reason) silently downgrades at runtime, and
     an interval with the sentinel off is inert — both warned GLS103 here so
-    the operator learns it before a multi-day run does."""
+    the operator learns it before a multi-day run does.
+    ``autotune``/``autotune_margin``/``elastic_strategy`` are the online-
+    autotuner flags: `apply` composed with a pinned --elastic_strategy is
+    refused outright (GLS017 — every swap the tuner performs would be undone
+    by the next migration resolving back to the pinned JSON), and knobs that
+    silently degrade or disable the tuner warn GLS103."""
     report = D.DiagnosticReport()
     report.extend(hp.structural_diagnostics())
     report.extend(hp.pipeline_engine_diagnostics())
@@ -537,6 +545,38 @@ def lint_hp(
             "GLS103", "sdc_interval is inert with sdc_check off: there is "
             "no integrity digest to emit",
             key="sdc_interval",
+        ))
+    autotune_mode = autotune or "off"
+    if autotune_mode == "apply" and elastic_strategy:
+        report.add(D.make(
+            "GLS017", "--autotune apply with a pinned --elastic_strategy: "
+            "any strategy the autotuner swaps to would be reverted by the "
+            "next migration resolving back to the pinned JSON; drop one of "
+            "the two (observe mode composes fine)",
+            key="autotune",
+        ))
+    if autotune_mode != "off":
+        if not hp.scan_layers:
+            report.add(D.make(
+                "GLS103", "autotune with scan_layers off: every hot-swap "
+                "recompiles a program whose build time grows with layer "
+                "count, inflating the swap cost the amortization check "
+                "must recover",
+                key="autotune",
+            ))
+        if hp.pp > 1:
+            report.add(D.make(
+                "GLS103", "autotune with pp=%d: the pipeline engines bypass "
+                "the per-LayerRun path, so the calibrator falls back to "
+                "whole-step scaling and the measured tables are coarser"
+                % hp.pp,
+                key="autotune",
+            ))
+    if autotune_margin is not None and autotune_mode == "off":
+        report.add(D.make(
+            "GLS103", "autotune_margin is inert with autotune off: there "
+            "is no re-search decision to apply the hysteresis to",
+            key="autotune_margin",
         ))
     if file:
         report.diagnostics = [

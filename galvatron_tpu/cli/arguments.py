@@ -296,6 +296,22 @@ def _add_train_args(p: argparse.ArgumentParser):
                         "device before it is quarantined (each observation "
                         "first repairs + re-executes; a tie vote only ever "
                         "re-executes)")
+    # online autotuner (runtime/autotune.py): measured-cost re-search with
+    # in-memory strategy hot-swap once the step time settles
+    r.add_argument("--autotune", type=str, default="off",
+                   choices=("off", "observe", "apply"),
+                   help="once the steady-state detector settles, fold the "
+                        "measured step time/memory back into the profiler "
+                        "tables and re-run the strategy search on them: "
+                        "'observe' logs the decision it WOULD take (the "
+                        "counterfactual), 'apply' hot-swaps to the new "
+                        "winner in memory through the live-migration path "
+                        "when it clears the hysteresis margin and the "
+                        "remaining-steps amortization check")
+    r.add_argument("--autotune_margin", type=float, default=None,
+                   help="hysteresis: the searched winner must beat the "
+                        "incumbent's predicted step time by more than this "
+                        "fraction to swap (default 0.05)")
 
 
 def _add_profile_args(p: argparse.ArgumentParser):
@@ -352,6 +368,16 @@ def _add_search_args(p: argparse.ArgumentParser):
     g.add_argument("--parallel_search", type=int, default=0)
     g.add_argument("--log_dir", type=str, default="logs")
     g.add_argument("--output_config_path", type=str, default=None)
+    # measured tables from `report --emit_profiles` (or a real profile run):
+    # explicit paths override the conventional config-dir lookup
+    g.add_argument("--time_profile_path", type=str, default=None,
+                   help="explicit computation-profiling JSON to search on "
+                        "(overrides the per-model config-dir convention; "
+                        "pairs with --memory_profile_path)")
+    g.add_argument("--memory_profile_path", type=str, default=None,
+                   help="explicit memory-profiling JSON to search on "
+                        "(overrides the per-model config-dir convention; "
+                        "pairs with --time_profile_path)")
     # comm-precision search axis (ROADMAP item 2: EQuARX / ZeRO++)
     g.add_argument("--comm_quant", type=str, default="off",
                    choices=("off", "bf16", "int8", "fp8_e4m3"),

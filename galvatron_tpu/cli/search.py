@@ -109,8 +109,12 @@ def search(args, world_size: Optional[int] = None) -> dict:
         allow_sequence_sharding=fam.supports_sequence_sharding,
     )
     mp = _model_paths(args, fam, cfg)
+    # explicit measured tables (report --emit_profiles output, or a profile
+    # run saved elsewhere) override the per-model config-dir convention
+    time_path = getattr(args, "time_profile_path", None) or mp["computation"]
+    mem_path = getattr(args, "memory_profile_path", None) or mp["memory"]
     engine.set_model_profiles(
-        read_json_config(mp["computation"]), read_json_config(mp["memory"])
+        read_json_config(time_path), read_json_config(mem_path)
     )
     hw = _hardware_paths(args.config_dir, world_size)
     engine.set_hardware_profiles(

@@ -226,6 +226,9 @@ def _train(args) -> dict:
         mode="train",
         sdc_check=getattr(args, "sdc_check", None),
         sdc_interval=getattr(args, "sdc_interval", None),
+        autotune=getattr(args, "autotune", None),
+        autotune_margin=getattr(args, "autotune_margin", None),
+        elastic_strategy=getattr(args, "elastic_strategy", None),
     )
     if jax.process_index() == 0:
         for _d in _report.warnings:
@@ -242,10 +245,14 @@ def _train(args) -> dict:
     step_flops = obs_flops.train_step_flops(cfg, hp.global_bsz)
     device_kind = getattr(jax.devices()[0], "device_kind", None)
     peak_flops = obs_flops.peak_flops_for(device_kind)
-    if telemetry.active_sink() is not None:
+    autotune_mode = getattr(args, "autotune", "off") or "off"
+    predictions = None
+    if telemetry.active_sink() is not None or autotune_mode != "off":
         # per-LayerRun cost-model predictions: the search engine's expected
         # time/memory per compiled run, recorded up-front so `cli report`
-        # can lay the measured steady state beside them (obs/attribution.py)
+        # can lay the measured steady state beside them (obs/attribution.py).
+        # The online autotuner needs the same rows (the FLOPs-share split the
+        # calibrator folds the measured step across), sink or no sink.
         from galvatron_tpu.obs import attribution as obs_attr
 
         try:
@@ -409,7 +416,36 @@ def _train(args) -> dict:
         pipeline_type=hp.pipeline_type,
         num_layers=hp.num_layers,
         resumed_from=args.load or None,
+        model_type=args.model_type,
+        hidden_size=getattr(cfg, "hidden_size", None),
+        num_heads=getattr(cfg, "num_heads", None),
+        num_kv_heads=getattr(cfg, "num_kv_heads", None),
+        ffn_hidden=getattr(cfg, "ffn_hidden", None),
+        vocab_size=getattr(cfg, "vocab_size", None),
+        seq_len=getattr(cfg, "max_seq_len", None),
+        mixed_precision=hp.mixed_precision,
+        activation=getattr(cfg, "activation", None),
     )
+
+    # ------------------------------------------------------- online autotuner
+    # runtime/autotune.py: once the step time settles, fold the measured
+    # steady state back into the profiler tables, re-search, and (apply mode)
+    # hot-swap through the live-migration path below. `observe` logs the
+    # decision it would take without acting on it.
+    tuner = None
+    autotune_comm_hidden = {"ms": sum(
+        float(r.get("comm_hidden_ms") or 0.0) for r in comm_hidden_rows)}
+    if autotune_mode != "off":
+        from galvatron_tpu.runtime import autotune as AT
+
+        tuner = AT.OnlineAutotuner(AT.AutotuneConfig(
+            mode=autotune_mode,
+            margin=getattr(args, "autotune_margin", None) or 0.05,
+            # driver-state seams (no CLI flags): tests shrink the settle
+            # window so the e2e fits the suite budget
+            window=getattr(args, "autotune_window", None) or 5,
+            rel_std=getattr(args, "autotune_rel_std", None) or 0.15,
+        ))
 
     def build_step_fn():
         """The jitted step for the CURRENT model/hp — also the rebuild path
@@ -769,6 +805,10 @@ def _train(args) -> dict:
             # training data (the learned budget tracks the steady step time)
             wd.observe_step_time(prof.all_times_ms[-1])
             wd.progress(d_it, inflight=len(inflight))
+        if tuner is not None:
+            tuner.observe_step(
+                prof.all_times_ms[-1] if prof.all_times_ms else None,
+                iteration=d_it)
         if args.profile or d_it % max(args.log_interval, 1) == 0:
             prof.log_iteration(d_it, metrics)
         loss = float(metrics["loss"])
@@ -944,7 +984,8 @@ def _train(args) -> dict:
             return True
         return False
 
-    def do_migrate(reason: str, target_world: Optional[int] = None) -> bool:
+    def do_migrate(reason: str, target_world: Optional[int] = None,
+                   target_hp=None) -> bool:
         """Live in-memory strategy migration (runtime/elastic.migrate): at a
         step boundary with the in-flight window drained and the prefetch
         thread torn down, resolve a strategy for `target_world` (operator
@@ -966,25 +1007,31 @@ def _train(args) -> dict:
             # (the next probe/SIGUSR1 re-raises it against the restored run)
             return False
         avail = [d for d in jax.devices() if int(d.id) not in sdc_quarantined]
-        world = int(target_world or len(avail))
-        new_hp = action = None
-        last_err = None
-        for w in range(world, 0, -1):
-            try:
-                new_hp, action = els.resolve_migration_strategy(args, cfg, w, hp)
-                world = w
-                break
-            except DiagnosticError as e:
-                # a quarantined world (e.g. 3 of 4 devices) often has no
-                # feasible strategy at its exact size; shrink until one fits
-                last_err = e
-                if reason != "sdc_quarantine":
-                    raise
-        if new_hp is None:
-            raise last_err
-        if world < len(avail) and jax.process_index() == 0:
-            print("migration (%s): no feasible strategy for all %d surviving "
-                  "device(s); migrating to %d" % (reason, len(avail), world))
+        if target_hp is not None:
+            # the caller (the autotuner) already searched and linted its
+            # winner; skip the resolve loop and swap straight to it
+            new_hp, action, world = target_hp, "autotune", target_hp.world_size
+        else:
+            world = int(target_world or len(avail))
+            new_hp = action = None
+            last_err = None
+            for w in range(world, 0, -1):
+                try:
+                    new_hp, action = els.resolve_migration_strategy(args, cfg, w, hp)
+                    world = w
+                    break
+                except DiagnosticError as e:
+                    # a quarantined world (e.g. 3 of 4 devices) often has no
+                    # feasible strategy at its exact size; shrink until one fits
+                    last_err = e
+                    if reason != "sdc_quarantine":
+                        raise
+            if new_hp is None:
+                raise last_err
+            if world < len(avail) and jax.process_index() == 0:
+                print("migration (%s): no feasible strategy for all %d "
+                      "surviving device(s); migrating to %d"
+                      % (reason, len(avail), world))
         if new_hp.to_json_dict() == hp.to_json_dict() and world == hp.world_size:
             # resolve BEFORE tearing anything down: a no-op request (already
             # on the target strategy — e.g. a repeated trigger) leaves the
@@ -1039,6 +1086,98 @@ def _train(args) -> dict:
             )
         return True
 
+    def autotune_plan() -> bool:
+        """One planning epoch of the online autotuner (runtime/autotune.py):
+        fold the measured steady state into the profiler tables, re-search
+        under the original memory budget with settle_bsz pinned to the live
+        global batch, and — in apply mode — hot-swap through do_migrate when
+        the predicted saving clears the hysteresis margin and amortizes over
+        the remaining steps. Returns True iff a swap happened (the loop
+        re-enters at the same step under the new strategy)."""
+        nonlocal predictions
+        from galvatron_tpu.runtime import autotune as AT
+
+        steady_ms = tuner.steady_step_ms()
+        remaining = max(args.train_iters - it, 0)
+        budget = getattr(args, "elastic_memory_gb", None) or \
+            provenance.get("memory_budget_gb") or els.DEFAULT_MEMORY_GB
+        from_json = hp.to_json_dict()
+        incumbent_ms = winner_ms = None
+        new_hp = tables = None
+        base = els.analytic_model_profiles(cfg, max_tp=hp.world_size)
+        if base is not None and steady_ms is not None:
+            tables = AT.calibrate_from_run(
+                cfg, hp, base[0], base[1], predictions or [], steady_ms,
+                comm_hidden_ms=autotune_comm_hidden["ms"],
+                compiled_memory_mb=prof.compiled_memory_mb,
+            )
+        if tables is not None:
+            tcfg, mcfg = tables
+            try:
+                new_hp = els.search_surviving_strategy(
+                    cfg, hp.world_size, hp.global_bsz, budget,
+                    model_type=args.model_type,
+                    config_dir=getattr(args, "config_dir", None),
+                    default_dp_type=hp.default_dp_type,
+                    time_config=tcfg, memory_config=mcfg,
+                )
+            except Exception as e:  # a failed re-search must not kill the run
+                telemetry.runtime_log("autotune search failed: %s" % e)
+                new_hp = None
+            if new_hp is not None:
+                # the winner inherits the run's execution knobs, exactly as
+                # resolve_migration_strategy grafts them onto a searched hp
+                for k in ("scan_layers", "remat_policy", "tp_comm_mode",
+                          "tp_comm_quant", "mixed_precision"):
+                    setattr(new_hp, k, getattr(hp, k))
+                incumbent_ms = AT.predicted_step_ms(cfg, hp, tcfg, mcfg)
+                winner_ms = AT.predicted_step_ms(cfg, new_hp, tcfg, mcfg)
+        decision = tuner.decide(
+            incumbent_ms, winner_ms, remaining,
+            identical=(new_hp is not None
+                       and new_hp.to_json_dict() == from_json),
+            target_hp=new_hp)
+        swapped = False
+        wall_ms = 0.0
+        if decision.swap and tuner.config.mode == "apply":
+            t0 = time.perf_counter()
+            swapped = do_migrate("autotune", target_hp=decision.target_hp)
+            wall_ms = (time.perf_counter() - t0) * 1e3
+        telemetry.emit(
+            "autotune", action="plan", iter=it, mode=tuner.config.mode,
+            reason=decision.reason,
+            steady_step_ms=steady_ms,
+            incumbent_ms=incumbent_ms, winner_ms=winner_ms,
+            predicted_saving_ms=decision.predicted_saving_ms,
+            margin=tuner.config.margin, remaining_steps=remaining,
+            swap_cost_ms=decision.swap_cost_ms,
+            swapped=int(swapped),
+            from_strategy=from_json,
+            to_strategy=new_hp.to_json_dict() if new_hp is not None else None,
+        )
+        if jax.process_index() == 0:
+            print("autotune (%s) at iteration %d: %s (steady %.2f ms, "
+                  "incumbent %s ms, winner %s ms)"
+                  % (tuner.config.mode, it,
+                     "swapping" if swapped else decision.reason,
+                     steady_ms or -1.0,
+                     "%.2f" % incumbent_ms if incumbent_ms else "-",
+                     "%.2f" % winner_ms if winner_ms else "-"))
+        if swapped:
+            tuner.mark_swapped(it, wall_ms, decision.predicted_saving_ms)
+            # the overlap measurement belongs to the old layout; a stale
+            # subtraction would mis-calibrate the next epoch
+            autotune_comm_hidden["ms"] = 0.0
+            try:
+                from galvatron_tpu.obs import attribution as obs_attr
+
+                predictions = obs_attr.predict_layer_runs(cfg, hp)
+            except Exception:
+                predictions = None
+            for p in predictions or ():
+                telemetry.emit("layer_run", **p)
+        return swapped
+
     try:
         while True:
             if interrupted is None and it < args.train_iters:
@@ -1084,6 +1223,10 @@ def _train(args) -> dict:
                     migrate_req.update(pending=False)
                     do_migrate(migrate_req["reason"], migrate_req["world"])
                     continue
+                if interrupted is None and tuner is not None \
+                        and tuner.plan_pending:
+                    if autotune_plan():
+                        continue
             if interrupted is not None or it >= args.train_iters:
                 # loop exit: forced full drain first. A rollback surfacing in
                 # the final drain resumes training at the restored iteration
@@ -1158,6 +1301,8 @@ def _train(args) -> dict:
     summary = prof.summary()
     summary["losses"] = losses
     summary["resilience"] = res.as_dict()
+    if tuner is not None:
+        summary["autotune"] = {"plans": tuner.plans, "swaps": tuner.swaps}
     if wd is not None:
         summary["watchdog"] = wd.summary()
     if interrupted is not None:

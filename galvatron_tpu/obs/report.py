@@ -43,13 +43,14 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from galvatron_tpu.obs import attribution as A
 from galvatron_tpu.obs import flops as F
+from galvatron_tpu.obs import steady as S
 from galvatron_tpu.obs import telemetry as T
 
 # lifecycle event types surfaced on the timeline, in schema order
 TIMELINE_TYPES = (
     "compile", "checkpoint_save", "checkpoint_restore", "checkpoint_gc",
     "anomaly_skip", "rollback", "retry", "preemption", "watchdog", "elastic",
-    "trace", "eval", "serve_drain", "serve_migrate",
+    "autotune", "trace", "eval", "serve_drain", "serve_migrate",
     "sdc_mismatch", "sdc_quarantine",
 )
 # serve_shed is deliberately NOT on the timeline: a shedding server emits
@@ -67,21 +68,10 @@ def detect_steady_state(
     values: Sequence[float], window: int = 5, rel_std: float = 0.15
 ) -> Tuple[Optional[int], str]:
     """(start index, method) of the steady-state region of a per-step time
-    series: the first index where the next `window` values have
-    stdev/mean <= rel_std. Falls back to the post-25% tail when the series
-    never settles ("fallback"), None when there is nothing to measure."""
-    vals = [float(v) for v in values if v is not None]
-    if not vals:
-        return None, "empty"
-    if len(vals) >= max(window, 2):
-        for i in range(0, len(vals) - window + 1):
-            win = vals[i:i + window]
-            mean = statistics.fmean(win)
-            if mean <= 0:
-                continue
-            if statistics.pstdev(win) / mean <= rel_std:
-                return i, "rolling-window"
-    return min(len(vals) - 1, len(vals) // 4), "fallback"
+    series. The detector itself lives in obs/steady.py (shared with the
+    online autotuner, which also needs the streaming form); this wrapper
+    keeps the report's historical tuple API."""
+    return S.detect(values, window=window, rel_std=rel_std).as_tuple()
 
 
 def _median(vals: Sequence[float]) -> Optional[float]:
@@ -179,6 +169,39 @@ def _integrity_section(
         "last_fold": (("0x%08x" % int(heartbeats[-1]["fold"]))
                       if heartbeats and heartbeats[-1].get("fold") is not None
                       else None),
+    }
+
+
+def _autotune_section(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Online-autotuner rollup (`train --autotune`): planning decisions,
+    applied swaps with predicted-vs-realized saving, and — in observe mode
+    — the counterfactuals (decisions that WOULD have swapped)."""
+    plans = [e for e in events if e.get("action") == "plan"]
+    realized = [e for e in events if e.get("action") == "realized"]
+    holds: Dict[str, int] = {}
+    for e in plans:
+        if not e.get("swapped"):
+            r = e.get("reason") or "?"
+            holds[r] = holds.get(r, 0) + 1
+    return {
+        "plans": len(plans),
+        "swaps": sum(1 for e in plans if e.get("swapped")),
+        "counterfactuals": sum(
+            1 for e in plans
+            if e.get("mode") == "observe" and e.get("reason") == "swap"),
+        "holds_by_reason": dict(sorted(holds.items())),
+        "predicted_saving_ms": sum(
+            e.get("predicted_saving_ms") or 0.0
+            for e in plans if e.get("swapped")) or None,
+        "counterfactual_saving_ms": sum(
+            e.get("predicted_saving_ms") or 0.0
+            for e in plans
+            if e.get("mode") == "observe" and e.get("reason") == "swap")
+            or None,
+        "realized_saving_ms": sum(
+            e.get("realized_saving_ms") or 0.0 for e in realized)
+            if realized else None,
+        "swapped_iters": [e.get("iter") for e in plans if e.get("swapped")],
     }
 
 
@@ -303,6 +326,9 @@ def analyze(
     if serve_reqs or decode_batches or sheds or drains or migrates:
         analysis["serving"] = _serving_section(
             serve_reqs, decode_batches, sheds, drains, migrates)
+    autotune_events = by_type.get("autotune", [])
+    if autotune_events:
+        analysis["autotuning"] = _autotune_section(autotune_events)
     run_end = by_type.get("run_end")
     if run_end and run_end[-1].get("summary") is not None:
         analysis["summary"] = run_end[-1]["summary"]
@@ -455,6 +481,27 @@ def render(analysis: Dict[str, Any]) -> str:
                    ", ".join("world %s->%s" % (a, b)
                              for a, b in sv["migrated_worlds"]))
             )
+    if analysis.get("autotuning"):
+        at = analysis["autotuning"]
+        lines.append("")
+        lines.append("autotuning:")
+        holds = " ".join(
+            "%s=%d" % (k, v) for k, v in at["holds_by_reason"].items())
+        lines.append(
+            "  plans: %s | swaps: %s%s%s"
+            % (_fmt(at["plans"]), _fmt(at["swaps"]),
+               (" (iters %s)" % ",".join(str(i) for i in at["swapped_iters"])
+                if at["swapped_iters"] else ""),
+               (" | held: %s" % holds) if holds else "")
+        )
+        lines.append(
+            "  predicted saving %s ms/step | realized %s ms/step | "
+            "counterfactual (observe) %s swaps worth %s ms/step"
+            % (_fmt(at["predicted_saving_ms"]),
+               _fmt(at["realized_saving_ms"]),
+               _fmt(at["counterfactuals"]),
+               _fmt(at["counterfactual_saving_ms"]))
+        )
     if analysis["timeline"]:
         lines.append("")
         lines.append("lifecycle timeline:")
@@ -481,6 +528,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="rolling-window length for steady-state detection")
     p.add_argument("--steady_tol", type=float, default=0.15,
                    help="relative stdev threshold for the steady window")
+    p.add_argument("--emit_profiles", type=str, default=None, metavar="DIR",
+                   help="offline calibrator: write measured per-layer "
+                        "time/memory tables (profiler JSON schema) from this "
+                        "stream into DIR, for search --time_profile_path/"
+                        "--memory_profile_path")
     return p
 
 
@@ -495,6 +547,21 @@ def run(argv: Optional[List[str]] = None) -> int:
         print("schema: %s: %s" % (args.path, err), file=sys.stderr)  # galv-lint: ignore[GLC006] -- CLI diagnostics
     analysis = analyze(events, window=args.steady_window, rel_std=args.steady_tol)
     analysis["schema_errors"] = errors
+    if args.emit_profiles:
+        # measured-table emission shares the online autotuner's calibrator;
+        # paths go to stderr so --json stdout stays machine-parseable
+        from galvatron_tpu.runtime import autotune as AT
+
+        try:
+            paths = AT.emit_profiles(
+                events, args.emit_profiles,
+                window=args.steady_window, rel_std=args.steady_tol)
+        except ValueError as e:
+            print("emit_profiles: %s" % e, file=sys.stderr)  # galv-lint: ignore[GLC006] -- CLI usage error
+            return 2
+        for kind, path in sorted(paths.items()):
+            print("emit_profiles: wrote %s table %s" % (kind, path),  # galv-lint: ignore[GLC006] -- CLI diagnostics
+                  file=sys.stderr)
     print(json.dumps(analysis, indent=2) if args.as_json else render(analysis))  # galv-lint: ignore[GLC006] -- CLI output
     return 1 if errors else 0
 

@@ -55,7 +55,13 @@ EVENT_SCHEMAS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
         ("model", "world_size"),
         ("strategy", "train_iters", "global_bsz", "start_iter",
          "model_flops_per_step", "peak_flops", "device_kind", "pipeline_type",
-         "num_layers", "resumed_from"),
+         "num_layers", "resumed_from",
+         # model-shape identity: enough for the offline calibrator
+         # (report --emit_profiles) to rebuild analytic base tables and the
+         # profiler's file tag without the live model config
+         "model_type", "hidden_size", "num_heads", "num_kv_heads",
+         "ffn_hidden", "vocab_size", "seq_len", "mixed_precision",
+         "activation"),
     ),
     # one-off program build cost + the compiler-reported working set the
     # MemoryCostModel prediction is checked against
@@ -190,6 +196,21 @@ EVENT_SCHEMAS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
     "sdc_quarantine": (
         ("iter", "device_ids"),
         ("strikes", "reason"),
+    ),
+    # online autotuner (runtime/autotune.py). action="plan" is one
+    # measured-cost re-search decision: reason is
+    # "swap" | "hysteresis" | "amortization" | "identical" | "infeasible",
+    # swapped is 0/1 (observe mode never swaps — a reason of "swap" with
+    # swapped=0 is the logged counterfactual); the before/after strategy
+    # JSON rides along like the elastic migrate event's. action="realized"
+    # follows a swap once the new strategy re-settles, closing the
+    # predicted-vs-realized loop.
+    "autotune": (
+        ("action",),
+        ("iter", "mode", "reason", "steady_step_ms", "incumbent_ms",
+         "winner_ms", "predicted_saving_ms", "margin", "remaining_steps",
+         "swap_cost_ms", "swapped", "from_strategy", "to_strategy",
+         "step_ms_before", "step_ms_after", "realized_saving_ms"),
     ),
     # jax.profiler start/stop_trace bracketing (--xla_trace)
     "trace": (("action",), ("dir", "first_step", "last_step", "error")),

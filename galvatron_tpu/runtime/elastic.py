@@ -211,10 +211,15 @@ def search_surviving_strategy(
     config_dir: Optional[str] = None,
     default_dp_type: str = "ddp",
     logger=None,
+    time_config: Optional[dict] = None,
+    memory_config: Optional[dict] = None,
 ) -> Optional[HybridParallelConfig]:
     """Re-run the strategy search for the surviving world size under the
     same global batch and memory budget. Profiled tables are used when
     `config_dir` has them for this model; otherwise the analytic fallback.
+    Explicit `time_config`/`memory_config` (profiler JSON schema) override
+    both — the online autotuner re-searches on MEASURED tables through this
+    exact recipe, so settle_bsz stays pinned to the live global batch.
     Returns None when nothing fits (the caller turns that into GLS203)."""
     from galvatron_tpu.search.engine import GalvatronSearchEngine, SearchArgs
 
@@ -252,6 +257,8 @@ def search_surviving_strategy(
         allreduce, p2p, overlap = analytic_hardware_profiles(live_world)
     else:
         time_cfg, mem_cfg, allreduce, p2p, overlap = profiles
+    if time_config is not None and memory_config is not None:
+        time_cfg, mem_cfg = time_config, memory_config  # measured tables win
     engine.set_model_profiles(time_cfg, mem_cfg)
     engine.set_hardware_profiles(allreduce, p2p, overlap)
     engine.initialize_search_engine()

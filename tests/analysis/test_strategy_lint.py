@@ -313,3 +313,47 @@ def test_tp_comm_quant_inert_at_tp1_warns_gls103():
     assert report.ok, report.render()
     msgs = [d.message for d in report.warnings if d.code == "GLS103"]
     assert any("tp_comm_quant" in m for m in msgs), report.render()
+
+
+# ------------------------------------------------------- online autotuner
+def _dp8(**kw):
+    from galvatron_tpu.config.strategy import HybridParallelConfig
+
+    return HybridParallelConfig.uniform(WORLD, 4, global_bsz=8, **kw)
+
+
+def test_autotune_apply_with_pinned_strategy_is_gls017():
+    report = S.lint_hp(
+        _dp8(), autotune="apply", elastic_strategy="/tmp/pinned.json")
+    assert not report.ok and "GLS017" in report.codes(), report.render()
+    [d] = [d for d in report.errors if d.code == "GLS017"]
+    assert "elastic_strategy" in d.message
+
+
+def test_autotune_observe_with_pinned_strategy_composes():
+    report = S.lint_hp(
+        _dp8(), autotune="observe", elastic_strategy="/tmp/pinned.json")
+    assert "GLS017" not in report.codes(), report.render()
+
+
+def test_autotune_without_scan_layers_warns_gls103():
+    report = S.lint_hp(_dp8(scan_layers=False), autotune="apply")
+    assert report.ok, report.render()
+    msgs = [d.message for d in report.warnings if d.code == "GLS103"]
+    assert any("scan_layers" in m for m in msgs), report.render()
+
+
+def test_autotune_with_pipeline_warns_gls103():
+    report = S.lint_hp(_dp8(pp=2, chunks=2), autotune="observe")
+    assert report.ok, report.render()
+    msgs = [d.message for d in report.warnings if d.code == "GLS103"]
+    assert any("per-LayerRun" in m for m in msgs), report.render()
+
+
+def test_autotune_margin_inert_without_mode_warns_gls103():
+    report = S.lint_hp(_dp8(), autotune_margin=0.1)
+    msgs = [d.message for d in report.warnings if d.code == "GLS103"]
+    assert any("autotune_margin" in m for m in msgs), report.render()
+    # ... and is clean when the tuner is actually on
+    report2 = S.lint_hp(_dp8(), autotune="apply", autotune_margin=0.1)
+    assert "GLS103" not in report2.codes(), report2.render()

@@ -218,3 +218,25 @@ def test_report_cli_schema_violation_exits_one(tmp_path, capsys):
 
 def test_report_cli_missing_file_exits_two(tmp_path, capsys):
     assert R.run([str(tmp_path / "nope.jsonl")]) == 2
+
+
+def test_report_autotuning_rollup_from_golden():
+    """The golden stream's autotune plan (observe-mode counterfactual) rolls
+    up into the autotuning section and joins the lifecycle timeline."""
+    events, errors = T.read_events(GOLDEN)
+    assert errors == []
+    analysis = R.analyze(events)
+    at = analysis["autotuning"]
+    assert at["plans"] == 1 and at["swaps"] == 0
+    # observe mode with reason=swap: a counterfactual, not an applied swap
+    assert at["counterfactuals"] == 1
+    assert at["counterfactual_saving_ms"] == pytest.approx(20.1)
+    assert at["predicted_saving_ms"] is None
+    assert at["realized_saving_ms"] is None
+    assert at["swapped_iters"] == []
+    assert "autotune" in [e["type"] for e in analysis["timeline"]]
+    text = R.render(analysis)
+    assert "autotuning:" in text
+    # a stream with no autotune events carries no section
+    rest = [e for e in events if e["type"] != "autotune"]
+    assert "autotuning" not in R.analyze(rest)

@@ -1,0 +1,81 @@
+"""obs/steady.py: the one steady-state detector shared by the report CLI
+and the online autotuner — batch and streaming must agree."""
+
+import statistics
+
+import pytest
+
+from galvatron_tpu.obs import steady as S
+
+# compile spike, two settling steps, then a flat steady tail
+SERIES = [900.0, 300.0, 210.0, 200.0, 202.0, 198.0, 201.0, 199.0]
+
+
+def test_batch_detects_rolling_window():
+    st = S.detect(SERIES, window=4, rel_std=0.05)
+    assert st.settled and st.method == "rolling-window"
+    assert st.start_index == 2  # first window [210,200,202,198] within 5%
+    assert st.as_tuple() == (2, "rolling-window")
+
+
+def test_streaming_agrees_with_batch():
+    det = S.SteadyStateDetector(window=4, rel_std=0.05)
+    settle_at = None
+    for i, v in enumerate(SERIES):
+        if det.push(v) is not None and settle_at is None:
+            settle_at = i
+    batch = S.detect(SERIES, window=4, rel_std=0.05)
+    assert det.settled
+    assert det.state().start_index == batch.start_index
+    # settles at the push that completes the first qualifying window
+    assert settle_at == batch.start_index + 4 - 1
+
+
+def test_fallback_is_explicitly_unsettled():
+    noisy = [100.0, 900.0, 50.0, 700.0, 120.0, 800.0, 60.0, 500.0]
+    st = S.detect(noisy, window=4, rel_std=0.05)
+    assert not st.settled and st.method == "fallback"
+    assert st.start_index == min(len(noisy) - 1, len(noisy) // 4)
+    det = S.SteadyStateDetector(window=4, rel_std=0.05)
+    for v in noisy:
+        det.push(v)
+    assert not det.settled
+    assert det.state().method == "fallback"
+    # fallback still yields a usable number (the report path)
+    assert det.steady_step_ms() is not None
+
+
+def test_empty_and_none_values():
+    st = S.detect([], window=4)
+    assert st.start_index is None and st.method == "empty" and not st.settled
+    # None entries (step events without iter_ms) are dropped, not crashed on
+    st2 = S.detect([None, None], window=4)
+    assert st2.method == "empty"
+    det = S.SteadyStateDetector(window=4)
+    det.push(None)
+    assert not det.settled and det.steady_step_ms() is None
+
+
+def test_flat_series_settles_at_zero():
+    st = S.detect([100.0] * 6, window=4, rel_std=0.05)
+    assert st.settled and st.start_index == 0
+
+
+def test_steady_step_ms_is_tail_median():
+    det = S.SteadyStateDetector(window=4, rel_std=0.05)
+    for v in SERIES:
+        det.push(v)
+    tail = SERIES[det.state().start_index:]
+    assert det.steady_step_ms() == pytest.approx(statistics.median(tail))
+
+
+def test_reset_starts_new_epoch():
+    det = S.SteadyStateDetector(window=4, rel_std=0.05)
+    for v in SERIES:
+        det.push(v)
+    assert det.settled
+    det.reset()
+    assert not det.settled and det.steady_step_ms() is None
+    for v in (50.0, 51.0, 50.0, 49.0):
+        det.push(v)
+    assert det.settled and det.state().start_index == 0

@@ -1,0 +1,180 @@
+"""runtime/autotune.py unit surface: the measured-table calibrator and the
+swap decision logic, jax-free (the driver e2e lives in
+tests/cli/test_autotune_driver.py)."""
+
+import pytest
+
+from galvatron_tpu.obs import telemetry as T
+from galvatron_tpu.runtime import autotune as AT
+
+# One body layer carrying 80% of the FLOPs, an unpriced embed/head row with
+# the rest — the analytic predict_layer_runs shape.
+BODY = {"run": 0, "predicted_ms": 100.0, "flops_share": 0.8,
+        "predicted_memory_mb": 500.0}
+HEAD = {"run": -1, "flops_share": 0.2}
+BASE_TIME = {"layertype_0": 10.0, "other_time": [1.0, 2.0], "maxbsz": 42}
+BASE_MEM = {
+    "layertype_0": {"parameter_size": 7.0,
+                    "tp_activation_per_bsz_dict": {"1": 10.0, "2": 6.0}},
+    "other_memory_pp_off": {"model_states": {"1": 3.0},
+                            "activation": {"1": 4.0}},
+}
+
+
+def _rows():
+    return [dict(BODY), dict(HEAD)]
+
+
+# ------------------------------------------------------------- calibrator
+
+def test_compute_ratio_scales_time_table():
+    # measured 250 ms * 0.8 share = 200 ms of body compute vs 100 predicted
+    time_cfg, mem_cfg = AT.measured_model_profiles(
+        BASE_TIME, BASE_MEM, _rows(), steady_step_ms=250.0)
+    assert time_cfg["layertype_0"] == pytest.approx(20.0)
+    # unpriced head inherits the body ratio; [m, c] entries scale both terms
+    assert time_cfg["other_time"] == pytest.approx([2.0, 4.0])
+    # non-time keys pass through untouched
+    assert time_cfg["maxbsz"] == 42
+    # no compiled memory -> memory table is a faithful copy
+    assert mem_cfg == BASE_MEM and mem_cfg is not BASE_MEM
+
+
+def test_comm_price_is_subtracted_not_inflated():
+    # 40 ms of the 100 ms prediction is communication priced from the
+    # hardware tables; the ratio must solve compute*r + comm = measured
+    time_cfg, _ = AT.measured_model_profiles(
+        BASE_TIME, BASE_MEM, _rows(), steady_step_ms=250.0, pred_comm_ms=40.0)
+    ratio = (250.0 * 0.8 - 40.0) / (100.0 - 40.0)
+    assert time_cfg["layertype_0"] == pytest.approx(10.0 * ratio)
+
+
+def test_all_comm_prediction_is_uncalibratable():
+    assert AT.measured_model_profiles(
+        BASE_TIME, BASE_MEM, _rows(), steady_step_ms=250.0,
+        pred_comm_ms=100.0) is None
+
+
+def test_body_floor_survives_bad_comm_estimate():
+    # comm_hidden larger than the whole step cannot drive compute negative
+    time_cfg, _ = AT.measured_model_profiles(
+        BASE_TIME, BASE_MEM, _rows(), steady_step_ms=250.0,
+        comm_hidden_ms=1e6)
+    floor = AT._MIN_BODY_FRACTION * 250.0 * 0.8
+    assert time_cfg["layertype_0"] == pytest.approx(10.0 * floor / 100.0)
+
+
+def test_priced_head_gets_its_own_ratio():
+    rows = [dict(BODY), {"run": -1, "flops_share": 0.2, "predicted_ms": 10.0}]
+    time_cfg, _ = AT.measured_model_profiles(
+        BASE_TIME, BASE_MEM, rows, steady_step_ms=250.0)
+    assert time_cfg["other_time"] == pytest.approx([5.0, 10.0])  # 250*0.2/10
+
+
+def test_memory_ratio_clamped_and_parameters_exact():
+    _, mem_cfg = AT.measured_model_profiles(
+        BASE_TIME, BASE_MEM, _rows(), steady_step_ms=250.0,
+        compiled_memory_mb=10000.0)  # raw ratio 20 -> clamped to 5
+    assert mem_cfg["layertype_0"]["tp_activation_per_bsz_dict"]["1"] == pytest.approx(50.0)
+    assert mem_cfg["other_memory_pp_off"]["activation"]["1"] == pytest.approx(20.0)
+    # parameter/model-state bytes are analytic and must not rescale
+    assert mem_cfg["layertype_0"]["parameter_size"] == pytest.approx(7.0)
+    assert mem_cfg["other_memory_pp_off"]["model_states"]["1"] == pytest.approx(3.0)
+    assert BASE_MEM["layertype_0"]["tp_activation_per_bsz_dict"]["1"] == 10.0
+
+
+def test_unusable_inputs_return_none():
+    assert AT.measured_model_profiles(BASE_TIME, BASE_MEM, _rows(), None) is None
+    assert AT.measured_model_profiles(BASE_TIME, BASE_MEM, [], 250.0) is None
+    head_only = [{"run": -1, "flops_share": 1.0}]
+    assert AT.measured_model_profiles(BASE_TIME, BASE_MEM, head_only, 250.0) is None
+
+
+def test_calibrate_from_run_prices_comm_on_zeroed_tables(monkeypatch):
+    seen = {}
+
+    def fake_pred(cfg, hp, time_config=None, memory_config=None):
+        seen["time"] = time_config
+        return 40.0
+
+    monkeypatch.setattr(AT, "predicted_step_ms", fake_pred)
+    time_cfg, _ = AT.calibrate_from_run(
+        object(), object(), BASE_TIME, BASE_MEM, _rows(), steady_step_ms=250.0)
+    # the comm-pricing pass saw a table with every compute entry zeroed
+    assert seen["time"]["layertype_0"] == 0.0
+    assert seen["time"]["other_time"] == [0.0, 0.0]
+    assert seen["time"]["maxbsz"] == 42
+    ratio = (250.0 * 0.8 - 40.0) / (100.0 - 40.0)
+    assert time_cfg["layertype_0"] == pytest.approx(10.0 * ratio)
+
+
+# --------------------------------------------------------------- decisions
+
+def _settled_tuner(**kw):
+    tuner = AT.OnlineAutotuner(AT.AutotuneConfig(mode="apply", window=3, **kw))
+    for ms in (100.0, 100.0, 100.0):
+        tuner.observe_step(ms)
+    assert tuner.plan_pending
+    return tuner
+
+
+def test_decide_swap_and_epoch_bookkeeping():
+    tuner = _settled_tuner()
+    d = tuner.decide(100.0, 80.0, remaining_steps=50, identical=False)
+    assert d.swap and d.reason == "swap"
+    assert d.predicted_saving_ms == pytest.approx(20.0)
+    # one decision per settle: the epoch is spent
+    assert not tuner.plan_pending and tuner.plans == 1
+
+
+def test_decide_hysteresis():
+    tuner = _settled_tuner(margin=0.25)
+    d = tuner.decide(100.0, 80.0, remaining_steps=50, identical=False)
+    assert not d.swap and d.reason == "hysteresis"
+
+
+def test_decide_amortization():
+    tuner = _settled_tuner()
+    tuner.config.swap_cost_ms = 5000.0  # learned from a prior swap
+    d = tuner.decide(100.0, 80.0, remaining_steps=10, identical=False)
+    assert not d.swap and d.reason == "amortization"
+    # ... but a long enough remaining horizon justifies it
+    tuner2 = _settled_tuner()
+    tuner2.config.swap_cost_ms = 5000.0
+    assert tuner2.decide(100.0, 80.0, 1000, identical=False).swap
+
+
+def test_decide_identical_and_infeasible():
+    tuner = _settled_tuner()
+    assert tuner.decide(100.0, 100.0, 50, identical=True).reason == "identical"
+    tuner2 = _settled_tuner()
+    d = tuner2.decide(None, None, 50, identical=False)
+    assert d.reason == "infeasible" and not d.swap
+
+
+def test_swap_cost_learning_and_realized_event():
+    tuner = _settled_tuner()
+    d = tuner.decide(100.0, 80.0, remaining_steps=50, identical=False)
+    tuner.mark_swapped(5, relayout_wall_ms=200.0,
+                       predicted_saving_ms=d.predicted_saving_ms)
+    assert tuner.swaps == 1 and tuner.plan_pending is False
+    sink = T.install(T.MemorySink())
+    try:
+        # first post-swap step is the recompile spike: funds the cost
+        # estimate (200 wall + 50 spike over the 100 ms steady) and is
+        # excluded from the new epoch's series
+        tuner.observe_step(150.0, iteration=6)
+        assert tuner.config.swap_cost_ms == pytest.approx(250.0)
+        assert not tuner.detector.settled
+        for it, ms in enumerate((80.0, 80.0, 80.0), start=7):
+            tuner.observe_step(ms, iteration=it)
+        [ev] = [e for e in sink.events if e["type"] == "autotune"]
+        assert ev["action"] == "realized"
+        assert ev["step_ms_before"] == pytest.approx(100.0)
+        assert ev["step_ms_after"] == pytest.approx(80.0)
+        assert ev["realized_saving_ms"] == pytest.approx(20.0)
+        assert ev["predicted_saving_ms"] == pytest.approx(20.0)
+        # the new epoch settled -> a fresh plan is pending
+        assert tuner.plan_pending
+    finally:
+        T.uninstall(sink)

@@ -80,13 +80,16 @@ def test_mfu_regression_gate_exit_codes(tmp_path):
                                    "ttft_ms_p99": 240.0}},
             "sdc_overhead": {"off": {"step_ms": 8.0},
                              "digest": {"step_ms": 8.1},
-                             "vote": {"step_ms": 9.0}}}}}
+                             "vote": {"step_ms": 9.0}},
+            "autotune": {"misspecified": {"steps_per_s": 10.0},
+                         "converged": {"steps_per_s": 12.0}}}}}
     empty_round = {"n": 4, "parsed": None}  # wedged round: tolerated, skipped
     (tmp_path / "BENCH_r03.json").write_text(json.dumps(baseline))
     (tmp_path / "BENCH_r04.json").write_text(json.dumps(empty_round))
 
     def run_gate(mfu, gate="1", overlap_step_ms=9.0, quant_step_ms=22.0,
-                 serve_tps=64.0, serve_step_ms=2.0, sdc_digest_step_ms=8.1):
+                 serve_tps=64.0, serve_step_ms=2.0, sdc_digest_step_ms=8.1,
+                 autotune_converged_sps=12.0):
         fake = tmp_path / "fake.json"
         fake.write_text(json.dumps({"results": {
             "train_step": {"mfu": mfu, "tokens_per_sec_per_chip": 30000.0},
@@ -101,7 +104,9 @@ def test_mfu_regression_gate_exit_codes(tmp_path):
                                    "ttft_ms_p99": 240.0}},
             "sdc_overhead": {"off": {"step_ms": 8.0},
                              "digest": {"step_ms": sdc_digest_step_ms},
-                             "vote": {"step_ms": 9.0}}}}))
+                             "vote": {"step_ms": 9.0}},
+            "autotune": {"misspecified": {"steps_per_s": 10.0},
+                         "converged": {"steps_per_s": autotune_converged_sps}}}}))
         env = dict(os.environ,
                    GALVATRON_BENCH_FAKE_RESULTS=str(fake),
                    GALVATRON_BENCH_GATE=gate,
@@ -137,6 +142,12 @@ def test_mfu_regression_gate_exit_codes(tmp_path):
     p = run_gate(0.4, sdc_digest_step_ms=10.0)
     assert p.returncode == 1, p.stdout
     assert "sdc_overhead.digest.step_ms" in p.stdout
+    # the autotuner's post-swap throughput is gated too (ISSUE 14): a
+    # converged strategy that stops beating the mis-specified start is a
+    # regression even with every other number healthy
+    p = run_gate(0.4, autotune_converged_sps=9.0)
+    assert p.returncode == 1, p.stdout
+    assert "autotune.converged.steps_per_s" in p.stdout
     p = run_gate(0.2, gate="")  # gate off: wedge-proofing contract holds
     assert p.returncode == 0 and "MFU-REGRESSION" not in p.stdout
     # no usable baseline at all: tolerated
