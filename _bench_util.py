@@ -201,6 +201,15 @@ GATE_METRICS = (
     ("extra.sdc_overhead.off.step_ms", False),
     ("extra.sdc_overhead.digest.step_ms", False),
     ("extra.sdc_overhead.vote.step_ms", False),
+    # Per-layer remat search (ISSUE 15): the gate pins all three remat
+    # plans' step time — the searched-mixed plan must keep beating the
+    # all-full plan it exists to improve on — and the searched plan's
+    # compiled memory footprint so the mix cannot silently drift toward
+    # holding everything resident
+    ("extra.remat.none.step_ms", False),
+    ("extra.remat.full.step_ms", False),
+    ("extra.remat.searched.step_ms", False),
+    ("extra.remat.searched.peak_mb", False),
     # Online autotuner (ISSUE 14): the gate pins throughput on both sides
     # of the mid-run hot-swap — the mis-specified start (detector + planner
     # riding along) and the converged post-swap strategy — so neither the

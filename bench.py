@@ -913,6 +913,157 @@ def section_sdc_overhead():
     return out
 
 
+def section_remat():
+    """Per-layer rematerialization search (ISSUE 15): all-none vs all-full
+    vs searched-mixed remat plans on the 4-virtual-device CPU config. The
+    searched leg is the real pipeline end to end — the DP with
+    remat_search=True over mock profiles, swept down from a roomy budget to
+    the first one that emits a MIXED per-layer plan (some layers
+    checkpointed under dots_saveable, some not), saved to the on-disk JSON
+    schema and loaded back through from_json — then that plan's per-layer
+    policies drive the measured train step layer-for-layer. Layers are
+    UNROLLED (scan_layers=False): under scan, XLA:CPU prices the
+    non-checkpointed path's stacked activation storage above the recompute
+    it saves (the autotune section's inversion), which would invert the
+    ordering this section exists to measure. Reports per-leg step_ms plus
+    the compiled executable's temp+output memory (the XLA:CPU analogue of
+    peak device memory) — expected ordering: full < searched < none on
+    memory, searched < full on step time."""
+    import numpy as np
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import tempfile
+
+    import jax.numpy as jnp
+    import optax
+
+    from galvatron_tpu.config.strategy import HybridParallelConfig
+    from galvatron_tpu.models import base as M
+    from galvatron_tpu.runtime.dataloader import get_train_iterator
+    from galvatron_tpu.runtime.model_api import construct_hybrid_parallel_model
+    from galvatron_tpu.search.engine import GalvatronSearchEngine, SearchArgs
+
+    S_, H_, NL, BSZ = (32, 32, 4, 8) if SMOKE else (64, 64, 4, 8)
+    steps = 4 if SMOKE else 14
+    cfg = M.TransformerConfig(
+        hidden_size=H_, num_heads=4, num_layers=NL, vocab_size=256,
+        max_seq_len=S_, compute_dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+
+    # mock profiles (tests/search_engine shapes): the DP is pure python over
+    # these numbers, so the search itself costs milliseconds here
+    allreduce_bw = {"allreduce_size_4_consec_1": 155.0,
+                    "allreduce_size_4_consec_0": 150.0,
+                    "allreduce_size_2_consec_1": 130.0,
+                    "allreduce_size_2_consec_0": 145.0}
+    p2p_bw = {"pp_size_2": 160.0, "pp_size_4": 140.0}
+    time_config = {"layertype_0": 5.3, "other_time": 2.0}
+    memory_config = {
+        "layertype_0": {
+            "parameter_size": 96.0,
+            "tp_activation_per_bsz_dict": {
+                1: 500.0, 2: 260.0, 4: 140.0, "checkpoint": 30.0}},
+        "other_memory_pp_off": {
+            "model_states": {1: 3000.0, 2: 1500.0, 4: 750.0},
+            "activation": {1: 80.0, 2: 42.0, 4: 22.0}},
+        "other_memory_pp_on": {
+            "first_stage": {
+                "model_states": {1: 2000.0, 2: 1000.0, 4: 500.0},
+                "activation": {1: 50.0, 2: 26.0, 4: 14.0}},
+            "last_stage": {
+                "model_states": {1: 1500.0, 2: 750.0, 4: 375.0},
+                "activation": {1: 30.0, 2: 16.0, 4: 8.0}}},
+    }
+
+    def search(mem_gb):
+        args = SearchArgs(memory_constraint=mem_gb, settle_bsz=BSZ,
+                          settle_chunk=1, max_tp_deg=1, disable_pp=True,
+                          remat_search=True)
+        eng = GalvatronSearchEngine(
+            args, 4,
+            [{"hidden_size": 4096, "seq_len": 2048, "layer_num": NL}],
+            model_name="bench_remat")
+        eng.set_model_profiles(time_config, memory_config)
+        eng.set_hardware_profiles(allreduce_bw, p2p_bw, {"overlap_coe": 1.12})
+        eng.initialize_search_engine()
+        return eng, eng.parallelism_optimization()
+
+    tmp = tempfile.mkdtemp(prefix="galv_bench_remat_")
+    searched_hp, plan_desc, search_gb = None, None, None
+    for gb in (5.5, 5.0, 4.5, 4.0, 3.0):
+        eng, r = search(gb)
+        if r is None:
+            continue
+        cpts = [s[3].get("cpt", s[3].get("ckpt", 0)) for s in r["strategies"]]
+        rps = [s[3].get("rp", "full") for s in r["strategies"]]
+        if 0 < sum(cpts) < len(cpts):  # a genuinely mixed plan
+            path = eng.save_results(r, os.path.join(tmp, "mixed.json"))
+            searched_hp = HybridParallelConfig.from_json(
+                path, world_size=4, scan_layers=False,
+                mixed_precision="fp32")
+            plan_desc = ["%s" % (rp if c else "none")
+                         for c, rp in zip(cpts, rps)]
+            search_gb = gb
+            break
+
+    def leg(hp):
+        model = construct_hybrid_parallel_model(cfg, hp)
+        tx = optax.adam(1e-3)
+        params = model.init_params(jax.random.PRNGKey(0))
+        opt_state = model.init_opt_state(tx, params)
+        step = model.make_train_step(tx, donate=False)
+        it = get_train_iterator(hp, cfg.vocab_size, cfg.max_seq_len, seed=1)
+        batches = [model.shard_batch(next(it)) for _ in range(steps)]
+        entry = {}
+        try:
+            # XLA:CPU supports compiled memory accounting: temp+output is
+            # the executable's transient high-water analogue of peak HBM
+            ma = step.lower(params, opt_state, batches[0]).compile() \
+                     .memory_analysis()
+            entry["peak_mb"] = round(
+                (ma.temp_size_in_bytes + ma.output_size_in_bytes) / 2**20, 3)
+        except Exception:
+            pass  # accounting is backend-best-effort; step_ms still gates
+        t0 = time.perf_counter()
+        params, opt_state, m = step(params, opt_state, batches[0])
+        jax.block_until_ready(m["loss"])
+        entry["build_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+        times = []
+        for b in batches[1:]:
+            t0 = time.perf_counter()
+            params, opt_state, m = step(params, opt_state, b)
+            jax.block_until_ready(m["loss"])
+            times.append(time.perf_counter() - t0)
+        entry["step_ms"] = round(float(np.median(times)) * 1e3, 3)
+        entry["final_loss"] = round(float(m["loss"]), 6)
+        return entry
+
+    out = {"world": 4, "layers": NL, "seq": S_, "global_bsz": BSZ,
+           "train_steps": steps}
+    out["none"] = leg(HybridParallelConfig.uniform(
+        4, NL, tp=1, global_bsz=BSZ, mixed_precision="fp32",
+        scan_layers=False))
+    out["full"] = leg(HybridParallelConfig.uniform(
+        4, NL, tp=1, checkpoint=1, global_bsz=BSZ, mixed_precision="fp32",
+        scan_layers=False))
+    if searched_hp is not None:
+        out["searched"] = leg(searched_hp)
+        out["searched_plan"] = plan_desc
+        out["searched_budget_gb"] = search_gb
+        out["searched_vs_full"] = round(
+            out["searched"]["step_ms"] / max(out["full"]["step_ms"], 1e-9), 3)
+        # rematerialization recomputes the SAME forward — the trajectory
+        # must not move by one ulp across any of the three plans
+        out["losses_match"] = (
+            out["none"]["final_loss"] == out["full"]["final_loss"]
+            == out["searched"]["final_loss"])
+    else:
+        out["error"] = "no budget in the sweep produced a mixed plan"
+    return out
+
+
 def section_autotune():
     """Online autotuner (ISSUE 14): the shipped cli/train loop on the
     4-virtual-device CPU config started from a deliberately mis-specified
@@ -1026,6 +1177,7 @@ SECTIONS = {
     "serve": section_serve,
     "serve_degraded": section_serve_degraded,
     "sdc_overhead": section_sdc_overhead,
+    "remat": section_remat,
     "autotune": section_autotune,
 }
 
@@ -1044,7 +1196,7 @@ SECTION_BUDGETS = {"layer_fwd": 300.0, "train_step": 360.0, "breakdown": 200.0,
                    "masked_flash": 180.0, "train_loop": 200.0,
                    "tp_overlap": 200.0, "quant_comm": 200.0, "serve": 200.0,
                    "serve_degraded": 200.0, "sdc_overhead": 200.0,
-                   "autotune": 200.0}
+                   "remat": 200.0, "autotune": 200.0}
 _START = time.time()
 _ACTIVE_CHILD = None  # Popen of the in-flight section, for watchdog cleanup
 
@@ -1130,6 +1282,8 @@ def main():
             extra["serve_degraded"] = results["serve_degraded"]
         if results.get("sdc_overhead"):
             extra["sdc_overhead"] = results["sdc_overhead"]
+        if results.get("remat"):
+            extra["remat"] = results["remat"]
         if results.get("autotune"):
             extra["autotune"] = results["autotune"]
         if timing_hazards:
@@ -1252,6 +1406,12 @@ def main():
         }, reserve_s=floor)
     results["sdc_overhead"] = _run_section(
         "sdc_overhead", errors, extra_env={
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "")
+                          + " --xla_force_host_platform_device_count=4").strip(),
+        }, reserve_s=floor)
+    results["remat"] = _run_section(
+        "remat", errors, extra_env={
             "JAX_PLATFORMS": "cpu",
             "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "")
                           + " --xla_force_host_platform_device_count=4").strip(),

@@ -288,6 +288,8 @@ def estimate_stage_memory_mb(
             info["fsdp"] = 1
         if s.checkpoint:
             info["cpt"] = 1
+            if s.remat_policy != "full":
+                info["rp"] = s.remat_policy
         strategy = [hp.pp, s.tp, hp.dp(i), info]
         cost = MemoryCostModel(
             strategy, global_batch_size=hp.global_bsz,
@@ -369,6 +371,20 @@ def _warning_diagnostics(
                 "the GSPMD TP path" % (hp.tp_comm_mode, hp.pp),
                 key="tp_comm_mode",
             ))
+    # remat precedence rule (config/strategy.py): the per-layer serialized
+    # remat_policy is authoritative at runtime; a non-default global flag
+    # that disagrees with any layer was shadowed, not applied
+    if hp.remat_policy != "full" and any(
+            s.remat_policy != hp.remat_policy for s in hp.layers):
+        out.append(D.make(
+            "GLS103", "global remat_policy=%r is shadowed by serialized "
+            "per-layer policies (%d of %d layers differ): the per-layer "
+            "field is authoritative; drop the flag or edit the JSON"
+            % (hp.remat_policy,
+               sum(1 for s in hp.layers if s.remat_policy != hp.remat_policy),
+               hp.num_layers),
+            key="remat_policy",
+        ))
     # GLS101: estimated memory vs budget
     if memory_budget_gb:
         stage_mb = estimate_stage_memory_mb(hp, model_cfg, memory_profile)

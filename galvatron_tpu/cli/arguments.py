@@ -63,10 +63,15 @@ def _add_parallel_args(p: argparse.ArgumentParser):
                         "time grows with depth again)")
     g.add_argument("--remat_policy", type=str, default="full",
                    choices=("none", "full", "dots_saveable", "nothing_saveable"),
-                   help="jax.checkpoint policy for layers with checkpoint=1: "
-                        "'full' remats everything (default), 'dots_saveable' "
-                        "keeps matmul outputs resident, 'none' disables the "
-                        "checkpoint flags entirely")
+                   help="DEFAULT jax.checkpoint policy for layers with "
+                        "checkpoint=1: 'full' remats everything (default), "
+                        "'dots_saveable' keeps matmul outputs resident, "
+                        "'none' neutralizes the checkpoint flags. Precedence: "
+                        "remat_policy is a per-layer SERIALIZED strategy "
+                        "field; this flag only fills layers whose JSON lacks "
+                        "the key (uniform configs stamp it on every layer). "
+                        "A non-default flag shadowed by serialized per-layer "
+                        "values warns GLS103")
     g.add_argument("--tp_comm_mode", type=str, default="gspmd",
                    choices=("gspmd", "shard_map", "overlap"),
                    help="TP-collective execution path for layer runs: "
@@ -329,6 +334,11 @@ def _add_profile_args(p: argparse.ArgumentParser):
     g.add_argument("--layernum_max", type=int, default=2)
     g.add_argument("--max_tp_deg", type=int, default=8)
     g.add_argument("--profile_dp_type", type=str, default="zero3")
+    g.add_argument("--profile_remat", action="store_true", default=False,
+                   help="also measure the per-remat-policy backward "
+                        "recompute fraction (remat_recompute_frac in the "
+                        "computation table; TimeCostModel's profiled "
+                        "override for the remat search axis)")
 
 
 def _add_hardware_args(p: argparse.ArgumentParser):
@@ -395,6 +405,15 @@ def _add_search_args(p: argparse.ArgumentParser):
                         "quantized gradient sync (1.0 = all; 0.0 "
                         "effectively disables). Layers with the smallest "
                         "modeled time saving are de-quantized first")
+    # remat search axis (ROADMAP item 1: per-layer-run remat tuning)
+    g.add_argument("--remat_search", action="store_true", default=False,
+                   help="let the search choose per-layer remat policies: "
+                        "each checkpointed strategy gains a 'dots_saveable' "
+                        "variant (pin the dot outputs, recompute only the "
+                        "cheap tail), so a tight --memory_budget yields a "
+                        "MIXED per-layer plan between all-none (most memory) "
+                        "and all-full (most recompute); emitted as the "
+                        "serialized per-layer remat_policy field")
     # latency-aware serving objective (ROADMAP item 4)
     g.add_argument("--objective", type=str, default="train",
                    choices=("train", "serve"),
@@ -577,7 +596,9 @@ def hp_config_from_args(args, num_layers: int, world_size: int):
     get_hybrid_parallel_configs_api's two modes, hybrid_parallel_config.py:17-158)."""
     from galvatron_tpu.config.strategy import HybridParallelConfig
 
-    # runtime execution knobs (not part of the searched on-disk schema)
+    # runtime execution knobs. remat_policy is special: it is ALSO a
+    # serialized per-layer field — the flag only fills layers whose JSON
+    # lacks the key (from_json default) or stamps uniform configs
     exec_kw = dict(
         scan_layers=getattr(args, "scan_layers", True),
         remat_policy=getattr(args, "remat_policy", "full"),

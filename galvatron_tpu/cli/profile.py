@@ -33,6 +33,7 @@ def profile_model(args) -> dict:
         max_tp_deg=args.max_tp_deg,
         mixed_precision=args.mixed_precision,
         config_dir=args.config_dir,
+        profile_remat=bool(getattr(args, "profile_remat", False)),
     )
     if fam.make_profiler is not None:
         prof = fam.make_profiler(cfg, args.model_type, pargs)

@@ -43,6 +43,7 @@ def search_args_from(args) -> SearchArgs:
         comm_quant=getattr(args, "comm_quant", "off"),
         comm_quant_block=getattr(args, "comm_quant_block", 64),
         comm_quant_budget=getattr(args, "comm_quant_budget", 1.0),
+        remat_search=bool(getattr(args, "remat_search", False)),
         objective=getattr(args, "objective", "train"),
         p99_ttft_ms=getattr(args, "p99_ttft_ms", 0.0),
         p99_tpot_ms=getattr(args, "p99_tpot_ms", 0.0),

@@ -1120,6 +1120,10 @@ def _train(args) -> dict:
                     config_dir=getattr(args, "config_dir", None),
                     default_dp_type=hp.default_dp_type,
                     time_config=tcfg, memory_config=mcfg,
+                    # the re-plan searches the remat axis too: freed memory
+                    # from heavier per-layer remat can convert into fewer
+                    # chunks (settle_chunk=None sweeps them) and vice versa
+                    remat_search=True,
                 )
             except Exception as e:  # a failed re-search must not kill the run
                 telemetry.runtime_log("autotune search failed: %s" % e)

@@ -46,9 +46,16 @@ CP_MODES = ("ring", "zigzag")
 # jax.checkpoint policy applied to layers with checkpoint=1 (models/base.py
 # _remat): "full" is jax.checkpoint's default (save nothing, remat
 # everything — the reference's --checkpoint semantics), "none" disables the
-# per-layer checkpoint flags entirely, the *_saveable names select the
+# layer's checkpoint flag entirely, the *_saveable names select the
 # matching jax.checkpoint_policies member (dots_saveable keeps matmul
 # outputs resident and remats only the cheap elementwise chains).
+# A SERIALIZED per-layer strategy field since the remat search dimension
+# (LayerStrategy.remat_policy; on-disk key "remat_policy"): the search
+# engine chooses the policy per layer under the memory budget, exactly like
+# grad_comm_dtype. The global --remat_policy CLI flag survives only as a
+# default-override (HybridParallelConfig.remat_policy): it fills layers
+# whose JSON does not serialize the key; serialized per-layer values always
+# win, and a non-default flag shadowed by them warns GLS103.
 REMAT_POLICIES = ("none", "full", "dots_saveable", "nothing_saveable")
 # TP-collective execution path for layer runs (models/base.run_layers —
 # parallel/tp_shard_map.py): "gspmd" leaves the collectives to the
@@ -56,7 +63,7 @@ REMAT_POLICIES = ("none", "full", "dots_saveable", "nothing_saveable")
 # (visible/schedulable, undecomposed), "overlap" decomposes them into
 # ppermute-pipelined chunked matmuls (ring all-gather / reduce-scatter
 # overlapped with compute, the ring_attention idiom on the dense kernels).
-# A runtime knob like remat_policy: NOT serialized into the strategy JSON.
+# A runtime knob: NOT serialized into the strategy JSON.
 TP_COMM_MODES = ("gspmd", "shard_map", "overlap")
 # Wire precision of a collective's payload (parallel/quant_collectives.py):
 # "none" keeps the exact full-precision collective, "bf16" is a passthrough
@@ -74,8 +81,14 @@ PER_LAYER_KEYS = (
     "tp_sizes_enc", "tp_consecutive_flags", "cp_sizes_enc", "dp_types_enc",
     "use_sp", "checkpoint",
 )
-# per-layer comma-separated STRING enums (COMM_DTYPES), not int lists
-PER_LAYER_STR_KEYS = ("grad_comm_dtype", "param_comm_dtype")
+# per-layer comma-separated STRING enums, not int lists; each key validates
+# against its own allowed-value set (schema_diagnostics)
+PER_LAYER_STR_ENUMS = {
+    "grad_comm_dtype": COMM_DTYPES,
+    "param_comm_dtype": COMM_DTYPES,
+    "remat_policy": REMAT_POLICIES,
+}
+PER_LAYER_STR_KEYS = tuple(PER_LAYER_STR_ENUMS)
 SCALAR_KEYS = (
     "pp_deg", "global_bsz", "chunks", "pp_division", "pipeline_type",
     "default_dp_type", "vtp", "vsp", "vcp", "embed_sdp", "cp_mode",
@@ -127,16 +140,26 @@ def schema_diagnostics(cfg: dict) -> list:
                     % (k, cfg[k]), key=k,
                 ))
     str_arrays = {}
-    for k in PER_LAYER_STR_KEYS:
+    for k, allowed in PER_LAYER_STR_ENUMS.items():
         if k in cfg:
             str_arrays[k] = str2strlist(cfg[k])
             for i, v in enumerate(str_arrays[k]):
-                if v not in COMM_DTYPES:
+                if v not in allowed:
                     out.append(D.make(
                         "GLS005", "%s[%d]=%r must be one of %s"
-                        % (k, i, v, COMM_DTYPES), key=k, layer=i,
-                        hint=D.did_you_mean(v, COMM_DTYPES),
+                        % (k, i, v, allowed), key=k, layer=i,
+                        hint=D.did_you_mean(v, allowed),
                     ))
+    # a serialized remat_policy of all-"full" carries no information: "full"
+    # is what checkpoint=1 already means (and the from_json default), so the
+    # key only earns its place when some layer deviates
+    rp_vals = str_arrays.get("remat_policy")
+    if rp_vals and all(v == "full" for v in rp_vals):
+        out.append(D.make(
+            "GLS103", "serialized remat_policy is 'full' on every layer — it "
+            "duplicates the checkpoint flag (checkpoint=1 already remats "
+            "fully); drop the key", key="remat_policy",
+        ))
     if "tp_sizes_enc" in arrays:
         n = len(arrays["tp_sizes_enc"])
         for k, arr in list(arrays.items()) + list(str_arrays.items()):
@@ -207,6 +230,11 @@ class LayerStrategy:
     # the search engine's comm-precision axis chooses these per layer):
     grad_comm_dtype: str = "none"   # DP/ZeRO gradient sync payload
     param_comm_dtype: str = "none"  # ZeRO-3 weight all-gather payload
+    # jax.checkpoint policy this layer remats under when checkpoint=1
+    # (REMAT_POLICIES; serialized — the search engine's remat axis chooses
+    # the recompute-vs-memory point per layer). Inert on checkpoint=0
+    # layers; "none" disables remat for this layer even with checkpoint=1.
+    remat_policy: str = "full"
 
     def __post_init__(self):
         if self.tp < 1 or self.cp < 1:
@@ -217,6 +245,19 @@ class LayerStrategy:
             if getattr(self, k) not in COMM_DTYPES:
                 raise ValueError("%s must be one of %s, got %r"
                                  % (k, COMM_DTYPES, getattr(self, k)))
+        if self.remat_policy not in REMAT_POLICIES:
+            raise ValueError("remat_policy must be one of %s, got %r"
+                             % (REMAT_POLICIES, self.remat_policy))
+
+    @property
+    def effective_remat_policy(self) -> str:
+        """The jax.checkpoint policy this layer actually executes under:
+        checkpoint=0 layers never wrap (their serialized policy is inert),
+        and checkpoint=1 with remat_policy='none' opts the layer out. The
+        runtime (models/base.run_layers), the run splitter (layer_runs) and
+        the cost models all key on THIS, so inert differences never split a
+        scan run or fork a cost-model cache entry."""
+        return self.remat_policy if self.checkpoint else "none"
 
     @property
     def seq_shard_degree(self) -> int:
@@ -229,10 +270,11 @@ class LayerStrategy:
 class LayerRun:
     """A maximal run of consecutive layers that compile to ONE program: every
     layer in [start, stop) has the same mesh-axis assignment (LayerAxes),
-    the same activation-checkpoint flag, and lives on the same pipeline
-    stage. The runtime executes a run of length >= 2 as a single
-    `jax.lax.scan` over weight-stacked params (models/base.py run_layers),
-    so trace/compile cost is per-RUN, not per-layer."""
+    the same effective rematerialization policy (checkpoint flag + per-layer
+    remat_policy), and lives on the same pipeline stage. The runtime
+    executes a run of length >= 2 as a single `jax.lax.scan` over
+    weight-stacked params (models/base.py run_layers), so trace/compile
+    cost is per-RUN, not per-layer."""
 
     start: int
     stop: int  # exclusive
@@ -252,11 +294,13 @@ def layer_runs(config: "HybridParallelConfig") -> List[LayerRun]:
 
     Layers are grouped by the *realised* strategy — the LayerAxes their
     LayerStrategy maps to on this mesh — not by raw LayerStrategy equality,
-    so inert flag differences (e.g. ``sp`` or ``tp_consec`` at tp=1) do not
-    split a run. The checkpoint flag partitions (it changes the scanned
-    program) and runs never span a pipeline-stage boundary. Searched
-    strategies are piecewise-uniform in practice (PAPER.md), so this
-    typically yields a handful of runs regardless of depth."""
+    so inert flag differences (e.g. ``sp`` or ``tp_consec`` at tp=1, or a
+    remat_policy on a checkpoint=0 layer) do not split a run. The effective
+    remat policy partitions (checkpoint flag + remat_policy — each policy
+    wraps the scanned body in a different jax.checkpoint program) and runs
+    never span a pipeline-stage boundary. Searched strategies are
+    piecewise-uniform in practice (PAPER.md), so this typically yields a
+    handful of runs regardless of depth."""
     # lazy: parallel.mesh imports this module at top level
     from galvatron_tpu.parallel.mesh import layer_axes
 
@@ -264,7 +308,8 @@ def layer_runs(config: "HybridParallelConfig") -> List[LayerRun]:
     out: List[LayerRun] = []
     prev_key = None
     for i in range(config.num_layers):
-        key = (layer_axes(config, i), config.layers[i].checkpoint, stage_of[i])
+        key = (layer_axes(config, i),
+               config.layers[i].effective_remat_policy, stage_of[i])
         if out and key == prev_key:
             out[-1] = dataclasses.replace(out[-1], stop=i + 1)
         else:
@@ -313,7 +358,13 @@ class HybridParallelConfig:
     # are NOT part of the searched on-disk strategy schema):
     scan_layers: bool = True  # stack same-strategy layer runs into lax.scan
     # (depth-constant trace/compile cost); False = unroll every layer
-    remat_policy: str = "full"  # REMAT_POLICIES: policy for checkpoint=1 layers
+    # Global remat default-override (REMAT_POLICIES). PRECEDENCE RULE: the
+    # per-layer LayerStrategy.remat_policy is authoritative at runtime; this
+    # field only FILLS layers at construction — uniform() stamps it on every
+    # layer, from_json uses it for JSONs that do not serialize the
+    # "remat_policy" key. A non-default value shadowed by serialized
+    # per-layer policies is inert and warns GLS103 (strategy_lint).
+    remat_policy: str = "full"
     tp_comm_mode: str = "gspmd"  # TP_COMM_MODES: TP-collective execution path
     tp_comm_quant: str = "none"  # COMM_DTYPES: wire precision of the manual
     # TP ring payloads (parallel/tp_shard_map.py); requires a manual
@@ -518,10 +569,13 @@ class HybridParallelConfig:
                     key="pp_division",
                 ))
             elif len(set(stage_sigs)) != 1:
-                # report checkpoint-only divergence as GLS011 (the remat flag
-                # changes the scanned program), anything else as GLS010
+                # report remat-only divergence (checkpoint flag OR per-layer
+                # remat_policy — both change the scanned program, nothing
+                # else) as GLS011, anything else as GLS010
                 ckpt_only = len({
-                    tuple(dataclasses.replace(s, checkpoint=0) for s in sig)
+                    tuple(dataclasses.replace(s, checkpoint=0,
+                                              remat_policy="full")
+                          for s in sig)
                     for sig in stage_sigs
                 }) == 1
                 code = "GLS011" if ckpt_only else "GLS010"
@@ -607,14 +661,19 @@ class HybridParallelConfig:
         checkpoint: int = 0,
         grad_comm_dtype: str = "none",
         param_comm_dtype: str = "none",
+        remat_policy: str = "full",
         **kw,
     ) -> "HybridParallelConfig":
         """GLOBAL-mode config: one strategy for every layer (reference
-        hybrid_parallel_config.py:27-42)."""
+        hybrid_parallel_config.py:27-42). The global ``remat_policy``
+        default-override is stamped onto every layer here (there are no
+        serialized per-layer values to defer to in GLOBAL mode)."""
         layer = LayerStrategy(tp=tp, cp=cp, sp=sp, fsdp=sdp, checkpoint=checkpoint,
                               grad_comm_dtype=grad_comm_dtype,
-                              param_comm_dtype=param_comm_dtype)
-        return cls(world_size=world_size, pp=pp, layers=[layer] * num_layers, **kw)
+                              param_comm_dtype=param_comm_dtype,
+                              remat_policy=remat_policy)
+        return cls(world_size=world_size, pp=pp, layers=[layer] * num_layers,
+                   remat_policy=remat_policy, **kw)
 
     @classmethod
     def from_json(cls, path_or_dict, world_size: int, **overrides) -> "HybridParallelConfig":
@@ -641,11 +700,18 @@ class HybridParallelConfig:
             else ["none"] * n
         pcd = str2strlist(cfg["param_comm_dtype"]) if "param_comm_dtype" in cfg \
             else ["none"] * n
+        # precedence rule: serialized per-layer remat policies win; the
+        # global --remat_policy flag (arriving as the remat_policy override)
+        # only fills layers when the JSON does not carry the key
+        rp_default = overrides.get("remat_policy", "full")
+        rp = str2strlist(cfg["remat_policy"]) if "remat_policy" in cfg \
+            else [rp_default] * n
         layers = [
             LayerStrategy(
                 tp=tp_sizes[i], cp=cp_sizes[i], sp=use_sp[i], fsdp=dp_types[i],
                 checkpoint=ckpt[i], tp_consec=consec[i],
                 grad_comm_dtype=gcd[i], param_comm_dtype=pcd[i],
+                remat_policy=rp[i],
             )
             for i in range(n)
         ]
@@ -698,6 +764,11 @@ class HybridParallelConfig:
             "param_comm_dtype": strlist2str([s.param_comm_dtype for s in self.layers]),
             "comm_quant_block": self.comm_quant_block,
         } | ({
+            # serialized only when some layer deviates from "full": an
+            # all-"full" key duplicates the checkpoint flag (GLS103) and
+            # from_json default-fills it anyway, so round-trips stay clean
+            "remat_policy": strlist2str([s.remat_policy for s in self.layers]),
+        } if any(s.remat_policy != "full" for s in self.layers) else {}) | ({
             "serve_max_concurrency": self.serve_max_concurrency,
             "serve_page_size": self.serve_page_size,
         } if self.serve_max_concurrency or self.serve_page_size else {}) | ({
@@ -728,7 +799,8 @@ class HybridParallelConfig:
                     i, self.stage_of_layer[i], s.tp,
                     "(ulysses-sp)" if s.sp else "",
                     s.cp, self.dp(i), self.dp_type(i),
-                    " ckpt" if s.checkpoint else "",
+                    (" ckpt" if s.remat_policy == "full"
+                     else " ckpt[%s]" % s.remat_policy) if s.checkpoint else "",
                     "" if s.tp_consec else " nonconsec",
                     " gcomm=%s" % s.grad_comm_dtype
                     if s.grad_comm_dtype != "none" else "",

@@ -618,7 +618,6 @@ def run_layers(
     layers = params["layers"]
     if scan is None:
         scan = hp.scan_layers if hp is not None else True
-    policy = hp.remat_policy if hp is not None else "full"
     kvs: List[Tuple[jax.Array, jax.Array]] = []
 
     def unrolled(x, indices):
@@ -636,8 +635,13 @@ def run_layers(
                 continue
             fwd = _layer_fwd_fn(cfg, hp if use_hp else None, mesh, axes,
                                 attn_bias, hp.layers[i] if use_hp else None)
-            if use_hp and hp.layers[i].checkpoint and policy != "none":
-                fwd = _remat(fwd, policy)
+            # the per-layer serialized policy decides (checkpoint=1 layers
+            # default to "full"); the global --remat_policy flag was folded
+            # in at construction (config/strategy precedence rule)
+            if use_hp:
+                pol = hp.layers[i].effective_remat_policy
+                if pol != "none":
+                    fwd = _remat(fwd, pol)
             x = fwd(lp, x, positions)
         return x
 
@@ -673,8 +677,14 @@ def run_layers(
             continue
         body = _layer_fwd_fn(cfg, hp if use_hp else None, mesh, axes,
                              attn_bias, run.strategy if use_hp else None)
-        if use_hp and run.strategy.checkpoint and policy != "none":
-            body = _remat(body, policy)
+        if use_hp:
+            # a run is maximal over (axes, effective policy, stage) —
+            # config/strategy.layer_runs splits on differing remat_policy
+            # exactly like the checkpoint flag, so one policy wraps the
+            # whole scanned body
+            run_pol = run.strategy.effective_remat_policy
+            if run_pol != "none":
+                body = _remat(body, run_pol)
 
         def step(carry, lp, _body=body, _axes=axes):
             if use_hp:

@@ -50,13 +50,17 @@ def strategy_as_list(s: LayerStrategy, hp: HybridParallelConfig, layer_idx: int)
         info["gcd"] = s.grad_comm_dtype
     if s.param_comm_dtype != "none":
         info["pcd"] = s.param_comm_dtype
+    if s.remat_policy != "full":
+        info["rp"] = s.remat_policy
     return [hp.pp, s.tp, hp.dp(layer_idx), info]
 
 
 def describe_strategy(s: LayerStrategy, hp: HybridParallelConfig, layer_idx: int) -> str:
     return "tp%d%s cp%d dp%d%s%s%s" % (
         s.tp, "(sp)" if s.sp else "", s.cp, hp.dp(layer_idx),
-        "(z3)" if s.fsdp else "", " ckpt" if s.checkpoint else "",
+        "(z3)" if s.fsdp else "",
+        ((" ckpt" if s.remat_policy == "full" else " ckpt[%s]" % s.remat_policy)
+         if s.checkpoint else ""),
         " g%s" % s.grad_comm_dtype if s.grad_comm_dtype != "none" else "",
     )
 
@@ -141,6 +145,7 @@ def predict_layer_runs(
     pma = ProfileModelArgs(
         forward_computation_time=fwd_time,
         tp_activation_per_bsz_dict=act_dict,
+        remat_recompute_frac=(time_config or {}).get("remat_recompute_frac"),
     )
 
     runs = layer_runs(hp)
@@ -148,20 +153,31 @@ def predict_layer_runs(
     total_flops = sum(run_flops) if run_flops else None
     tp_comm_mode = getattr(hp, "tp_comm_mode", "gspmd")
 
+    # chunks-aware pricing (ROADMAP item 5 leftover): mirror the engine's
+    # pipeline_costmodel — per-MICROBATCH layer costs times the schedule's
+    # tick count. A run's step share is length x per-mb cost x ticks/pp
+    # (ticks = chunks + pp - 1, the GPipe fill+drain; the /pp spreads the
+    # lockstep tick cost over the stages so the rows still sum to ~one
+    # step). At chunks=1 this reduces exactly to the old full-batch
+    # pricing, so calibrations against chunk-less runs are unchanged.
+    chunks = max(1, int(hp.chunks or 1))
+    mb_bsz = hp.global_bsz / chunks
+    tick_factor = (chunks + hp.pp - 1) / hp.pp
+
     out: List[Dict[str, Any]] = []
     for idx, run in enumerate(runs):
         strategy = strategy_as_list(run.strategy, hp, run.start)
         tcm = TimeCostModel(
-            strategy, global_batch_size=hp.global_bsz,
+            strategy, global_batch_size=mb_bsz,
             model_args=ma, train_args=ta, parallel_args=pa,
             profile_model_args=pma, profile_hardware_args=pha,
         )
-        per_layer_ms = tcm.gen_result()
+        per_layer_ms = tcm.gen_result() * tick_factor
         # the TP-collective share of the layer, priced on the same scale as
         # gen_result — the term tp_comm_mode=overlap can hide behind the
         # chunked matmul schedule (bounded by the compute it overlaps with,
         # the T3 perfect-overlap model)
-        scale = pha.costmodel_coe / tcm.layer_num
+        scale = pha.costmodel_coe / tcm.layer_num * tick_factor
         per_layer_comm_ms = tcm.tp_communication_time * scale
         per_layer_hidden_ms = 0.0
         if tp_comm_mode == "overlap" and run.strategy.tp > 1:
@@ -196,6 +212,14 @@ def predict_layer_runs(
             entry["grad_comm_dtype"] = run.strategy.grad_comm_dtype
             entry["predicted_quant_overhead_ms"] = round(
                 tcm.quant_overhead_ms * scale * run.length, 4)
+        # remat axis: the policy-scaled recompute toll the cost model
+        # charged into the backward, beside the policy itself, so the
+        # report can lay predicted recompute against measured divergence
+        eff_rp = run.strategy.effective_remat_policy
+        if eff_rp != "none":
+            entry["remat_policy"] = eff_rp
+            entry["predicted_recompute_ms"] = round(
+                tcm.fct * tcm.remat_frac * scale * run.length, 4)
         if run_flops is not None:
             entry["flops"] = run_flops[idx]
             entry["flops_share"] = round(run_flops[idx] / total_flops, 6)
@@ -229,6 +253,7 @@ def divergence_rows(
             "predicted_memory_mb", "flops_share", "tp_comm_mode",
             "predicted_comm_ms", "predicted_comm_hidden_ms",
             "grad_comm_dtype", "predicted_quant_overhead_ms",
+            "remat_policy", "predicted_recompute_ms",
         )}
         share = p.get("flops_share")
         if measured_step_ms is not None and share is not None:
@@ -252,12 +277,15 @@ def render_divergence_table(rows: List[Dict[str, Any]]) -> str:
     # path (tp>1); dp-only tables keep the original width
     has_comm = any(r.get("predicted_comm_ms") is not None for r in rows)
     has_quant = any(r.get("grad_comm_dtype") is not None for r in rows)
+    has_remat = any(r.get("remat_policy") is not None for r in rows)
     header = ("run", "layers", "strategy", "pred_ms", "meas_ms", "ratio",
               "pred_mb", "share")
     if has_comm:
         header += ("comm_ms", "hid_ms")
     if has_quant:
         header += ("gcomm", "q_ms")
+    if has_remat:
+        header += ("remat", "rc_ms")
     body = []
     for r in rows:
         run = r.get("run")
@@ -279,6 +307,9 @@ def render_divergence_table(rows: List[Dict[str, Any]]) -> str:
         if has_quant:
             cells += (_fmt(r.get("grad_comm_dtype")),
                       _fmt(r.get("predicted_quant_overhead_ms")))
+        if has_remat:
+            cells += (_fmt(r.get("remat_policy")),
+                      _fmt(r.get("predicted_recompute_ms")))
         body.append(cells)
     widths = [max(len(header[i]), *(len(b[i]) for b in body)) for i in range(len(header))]
     lines = ["  ".join(h.ljust(w) for h, w in zip(header, widths))]

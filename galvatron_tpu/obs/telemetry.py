@@ -118,7 +118,8 @@ EVENT_SCHEMAS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
         ("strategy", "predicted_ms", "predicted_memory_mb", "flops",
          "flops_share", "tp_comm_mode", "predicted_comm_ms",
          "predicted_comm_hidden_ms", "grad_comm_dtype",
-         "predicted_quant_overhead_ms"),
+         "predicted_quant_overhead_ms", "remat_policy",
+         "predicted_recompute_ms"),
     ),
     # measured compute/collective overlap of the decomposed TP path
     # (parallel/tp_shard_map.measure_comm_hidden): per TP LayerRun, the

@@ -76,6 +76,10 @@ class ModelProfileArgs:
     max_tp_deg: int = 8
     mixed_precision: str = "bf16"
     config_dir: str = "configs"
+    # measure the per-remat-policy backward recompute fraction (strategy
+    # field remat_policy; TimeCostModel.remat_frac) — 4 extra grad-program
+    # compiles per layer type, so opt-in for quick profile runs
+    profile_remat: bool = False
 
 
 def _tree_bytes(tree) -> int:
@@ -319,6 +323,49 @@ class ModelProfiler:
 
         return fwd, layers, (x,)
 
+    def _grad_ms(self, t: int, bsz: int, seq: int, policy: Optional[str]) -> float:
+        """Per-layer fwd+bwd walltime (layer-differenced), with the stack
+        wrapped in jax.checkpoint under `policy` when given. Whole-stack
+        wrapping yields the same per-layer recompute toll as per-layer
+        wrapping — every layer's forward replays exactly once either way —
+        and reuses the family's _stack_t hook unchanged."""
+        from galvatron_tpu.models.base import _remat
+
+        a = self.args
+        lo, hi = a.layernum_min, a.layernum_max
+
+        def grad_prog(n):
+            fwd, layers, xs = self._stack_t(t, n, bsz, seq)
+            f = _remat(fwd, policy) if policy and policy != "none" else fwd
+            return (lambda ls, *xx: jax.grad(f)(ls, *xx)), (layers,) + tuple(xs)
+
+        g_lo, args_lo = grad_prog(lo)
+        g_hi, args_hi = grad_prog(hi)
+        t_lo = _walltime(jax.jit(g_lo), args_lo, a.warmup, a.iters)
+        t_hi = _walltime(jax.jit(g_hi), args_hi, a.warmup, a.iters)
+        return max((t_hi - t_lo) / (hi - lo) * 1e3, 1e-9)
+
+    def profile_remat(self, t: int = 0) -> Dict[str, float]:
+        """Measured backward recompute toll per remat policy, as a fraction
+        of the forward (TimeCostModel.remat_frac's profiled override):
+        frac(policy) = (grad_ms(policy) - grad_ms(no-remat)) / fwd_ms,
+        layer-differenced like every other table. Clamped to [0, 1.5] so
+        timer noise can never feed the search a negative (or absurd)
+        recompute price."""
+        a = self.args
+        seq = self._target_seq
+        bsz = a.profile_batch_size
+        fwd_ms = self._fwd_ms(t, bsz, seq) * bsz  # un-normalise to per-layer ms
+        base = self._grad_ms(t, bsz, seq, None)
+        out: Dict[str, float] = {"none": 0.0}
+        for pol in ("full", "nothing_saveable", "dots_saveable"):
+            frac = (self._grad_ms(t, bsz, seq, pol) - base) / max(fwd_ms, 1e-9)
+            out[pol] = round(float(min(max(frac, 0.0), 1.5)), 4)
+        # a policy that pins MORE tensors can never owe more recompute than
+        # full remat; enforce against timer noise on tiny profile models
+        out["dots_saveable"] = min(out["dots_saveable"], out["full"])
+        return out
+
     def _other_ms_per_sample(self, bsz: int, seq: int, per_layer_ms_sum: float) -> float:
         """Embedding + head + loss time: full tiny model minus its layers'
         share (reference separates this as 'other_time')."""
@@ -364,6 +411,10 @@ class ModelProfiler:
                 headline.append(out[key])
         bsz_for_other = a.profile_max_batch_size if a.profile_mode == "batch" else a.profile_batch_size
         out["other_time"] = self._other_ms_per_sample(bsz_for_other, seq, sum(headline))
+        if a.profile_remat:
+            # per-policy backward recompute fractions, consumed by
+            # TimeCostModel via ProfileModelArgs.remat_recompute_frac
+            out["remat_recompute_frac"] = self.profile_remat()
         return out
 
     # ----------------------------------------------------------------- memory

@@ -213,6 +213,7 @@ def search_surviving_strategy(
     logger=None,
     time_config: Optional[dict] = None,
     memory_config: Optional[dict] = None,
+    remat_search: bool = False,
 ) -> Optional[HybridParallelConfig]:
     """Re-run the strategy search for the surviving world size under the
     same global batch and memory budget. Profiled tables are used when
@@ -240,6 +241,9 @@ def search_surviving_strategy(
         max_pp_deg=min(_pow2_floor(num_layers), live_world),
         default_dp_type=default_dp_type,
         sp_space="tp",
+        # remat axis: the re-plan may mix per-layer policies (and, with
+        # settle_chunk=None, change chunks) when the budget rewards it
+        remat_search=remat_search,
     )
     engine = GalvatronSearchEngine(
         args, live_world,

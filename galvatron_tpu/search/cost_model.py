@@ -20,7 +20,9 @@ retargeted at this repo's TPU runtime:
   coefficients for pipeline transfer and ring attention.
 
 A "strategy" is the reference's list form: [pp, tp, dp, info] with info keys
-'fsdp', 'sp' (ulysses), 'cp', 'cpt' (activation ckpt), 'tp' (consecutive flag).
+'fsdp', 'sp' (ulysses), 'cp', 'cpt' (activation ckpt), 'tp' (consecutive flag),
+'gcd'/'pcd' (comm precision) and 'rp' (jax.checkpoint remat policy for
+checkpointed layers, default "full" — the remat search dimension).
 """
 
 from __future__ import annotations
@@ -122,7 +124,13 @@ class MemoryCostModel:
         info = _info(strategy)
         self.ulysses = bool(info.get("sp", 0))
         self.cp_size = int(info.get("cp", 1))
-        self.checkpoint = bool(info.get("cpt", info.get("ckpt", 0)))
+        cpt = bool(info.get("cpt", info.get("ckpt", 0)))
+        # remat axis: what the checkpointed layer SAVES decides what it holds.
+        # rp="none" on a cpt=1 strategy degenerates to no checkpointing;
+        # "dots_saveable" keeps the layer input PLUS the dot outputs;
+        # "full"/"nothing_saveable" keep the input only.
+        self.remat_policy = str(info.get("rp", "full")) if cpt else "none"
+        self.checkpoint = cpt and self.remat_policy != "none"
         self.fsdp = bool(info.get("fsdp", 0))
         ma, ta, pa, pma = model_args, train_args, parallel_args, profile_model_args
         self.args = ta
@@ -220,6 +228,24 @@ class MemoryCostModel:
                     return float(m)
             return act_per_bsz(act_tp_key) / seq_shard
 
+        def dots_extra_per_bsz():
+            """Extra saved-tensor MB per sample when the remat policy is
+            dots_saveable: beyond the layer input the policy pins every dot
+            output — qkv (3sh), attn-out (sh), mlp-up (4sh), mlp-down input
+            (sh) ≈ 9·seq·hidden elements (flash keeps scores out of HBM) —
+            all sharded tp-fold (head/ffn shard, or seq under ulysses) and
+            cp-fold. Prefers a profiled 'dots_saveable' row (per-sample MB at
+            tp=1, like 'checkpoint')."""
+            v = act.get("dots_saveable")
+            if v is None:
+                bytes_per = 2 if ta.mixed_precision else 4
+                v = 9.0 * ma.seq_length * ma.hidden_size * bytes_per / 1024 / 1024
+            return float(v) / (self.cp_size * self.tp_size)
+
+        dots_extra = (
+            dots_extra_per_bsz() if self.remat_policy == "dots_saveable" else 0.0
+        )
+
         mb_bsz = local_bsz / self.chunks
         ckpt_shard = seq_shard * (
             self.tp_size if pa.sequence_parallel and not self.ulysses else 1
@@ -245,16 +271,17 @@ class MemoryCostModel:
             )
             overhead = bufs * mb_bsz * input_act_mb / boundary_shard / lps
             if self.checkpoint:
-                per_mb = act_per_bsz("checkpoint") * mb_bsz / ckpt_shard
+                per_mb = (act_per_bsz("checkpoint") / ckpt_shard + dots_extra) * mb_bsz
             else:
                 per_mb = act_live_per_bsz() * mb_bsz
             self.activation_size = per_mb + overhead
         elif self.checkpoint:
-            # per-layer share under remat is just the layer input; the single
-            # transient recompute buffer is global, not per-layer (reference
-            # cost_model.py:130-138)
+            # per-layer share under remat is the layer input (plus the pinned
+            # dot outputs under dots_saveable); the single transient recompute
+            # buffer is global, not per-layer (reference cost_model.py:130-138)
             held_bsz = local_bsz if self.pp_size > 1 else mb_bsz
-            self.activation_size = act_per_bsz("checkpoint") * held_bsz / ckpt_shard
+            self.activation_size = (
+                act_per_bsz("checkpoint") / ckpt_shard + dots_extra) * held_bsz
         else:
             # pp=1 grad-accum frees per-microbatch activations; the scan
             # pipeline (pp>1) holds all chunks' stage inputs: model the full
@@ -359,7 +386,21 @@ class TimeCostModel:
         info = _info(strategy)
         self.ulysses = bool(info.get("sp", 0))
         self.cp_size = int(info.get("cp", 1))
-        self.checkpoint = bool(info.get("cpt", info.get("ckpt", 0)))
+        cpt = bool(info.get("cpt", info.get("ckpt", 0)))
+        # remat axis: recompute toll per policy as a fraction of the forward
+        # replayed inside the backward — 0 for "none" (nothing recomputed),
+        # 1 for "full"/"nothing_saveable" (whole forward replays), and an
+        # analytic ~0.35 for "dots_saveable" (the dots are pinned; only the
+        # cheap elementwise/softmax/layernorm tail replays). Profiled values
+        # (profile_computation's per-policy bwd measurement) override via
+        # ProfileModelArgs.remat_recompute_frac.
+        self.remat_policy = str(info.get("rp", "full")) if cpt else "none"
+        self.checkpoint = cpt and self.remat_policy != "none"
+        _frac_default = {"none": 0.0, "dots_saveable": 0.35,
+                         "full": 1.0, "nothing_saveable": 1.0}
+        _frac_prof = getattr(pma, "remat_recompute_frac", None) or {}
+        self.remat_frac = float(_frac_prof.get(
+            self.remat_policy, _frac_default.get(self.remat_policy, 1.0)))
         self.fsdp = bool(info.get("fsdp", 0))
         self.consec = bool(info.get("tp", 1))
         self.layer_num = ma.layer_num or 24
@@ -372,8 +413,7 @@ class TimeCostModel:
         per_shard_bsz = self.bsz / self.tp_size / self.cp_size
         self.fct = _eval_fit(pma.forward_computation_time, per_shard_bsz) * self.layer_num
         self.bct = self.fct * pha.bct_fct_coe
-        if self.checkpoint:
-            self.bct += self.fct  # recompute
+        self.bct += self.fct * self.remat_frac  # policy-scaled recompute
 
         # ---- dp (grad reduce) comm ---------------------------------------
         # comm-precision axis (ROADMAP item 2): the strategy's per-layer
@@ -419,7 +459,9 @@ class TimeCostModel:
         # megatron-sp layer: 2x(all-gather + reduce-scatter) fwd, same bwd ->
         # total volume equals 4 allreduces of bsz*seq*hidden per layer
         act_mb = self.bsz / self.cp_size * ma.seq_length * ma.hidden_size * (2 if ta.mixed_precision else 4) / 1024 / 1024
-        ncoll = 4 * (1.5 if self.checkpoint else 1.0)
+        # the recompute replays the 2 forward collectives scaled by the
+        # policy's replayed fraction (1.5x total at full remat, 1x at none)
+        ncoll = 4 * (1.0 + 0.5 * self.remat_frac)
         if self.ulysses:
             # ulysses: 4 all2alls on the attention boundary per layer
             per_msg = act_mb / self.tp_size
@@ -488,7 +530,9 @@ class TimeCostModel:
             # compute-only estimate (pipeline stage balancing)
             fwd, bwd = self.fct, self.bct
         else:
-            tp_fwd_frac = 1.0 / 3.0 if self.checkpoint else 0.5
+            # replayed forward collectives land in the backward slot: fwd
+            # share 1/2 at remat_frac=0, 1/3 at remat_frac=1
+            tp_fwd_frac = 1.0 / (2.0 + self.remat_frac)
             tp_f = self.tp_communication_time * tp_fwd_frac
             tp_b = self.tp_communication_time * (1.0 - tp_fwd_frac)
             if self.tp_size == 1 and self.dp_size > 1:

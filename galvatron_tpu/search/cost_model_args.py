@@ -53,6 +53,11 @@ class ProfileModelArgs:
     other_memory_pp_off: Dict[str, Dict[Any, float]] = field(default_factory=dict)
     other_memory_pp_on: Dict[str, Dict[str, Dict[Any, float]]] = field(default_factory=dict)
     other_time_profiled: Any = 1.0  # ms for embed+cls forward per sample
+    # measured backward-recompute fraction per remat policy (strategy info
+    # key 'rp'): {policy: replayed share of the forward}, written by
+    # profile_computation's per-policy fwd/bwd measurement; None falls back
+    # to the analytic table in TimeCostModel
+    remat_recompute_frac: Optional[Dict[str, float]] = None
 
 
 @dataclass

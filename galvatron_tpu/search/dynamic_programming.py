@@ -356,6 +356,15 @@ class DpOnModel:
                     cost[i, j] = 1e-9
                 if self._match_except(si, sj, ["cpt"]) and ij.get("cpt", 0):
                     cost[i, j] = 2e-9
+                # remat-policy twins (same layout + cpt, different rp): zero
+                # resharding; bias toward the lighter-recompute policy so
+                # equal-cost runs settle deterministically
+                if (
+                    self._match_except(si, sj, ["rp"])
+                    and ij.get("rp", "full") != "full"
+                    and ij.get("cpt", 0)
+                ):
+                    cost[i, j] = 15e-10
                 if (
                     self._match_except(si, sj, ["fsdp", "cpt"])
                     and not self._match_except(si, sj, ["fsdp"])

@@ -80,6 +80,12 @@ class SearchArgs:
     comm_quant: str = "off"  # off | bf16 | int8 | fp8_e4m3
     comm_quant_block: int = 64
     comm_quant_budget: float = 1.0  # max fraction of layers quantized
+    # remat axis (ROADMAP item 1): adds, for every checkpointed strategy, a
+    # 'dots_saveable' per-layer policy variant — the DP then mixes none /
+    # dots_saveable / full layer by layer under the memory budget. The other
+    # named policies degenerate to existing points ("none" == cpt=0,
+    # "nothing_saveable" prices like "full"), so only dots is enumerated.
+    remat_search: bool = False
     # latency-aware serving objective (ROADMAP item 4): "train" keeps the
     # classic throughput DP; "serve" prices prefill (compute-bound) and
     # decode (bandwidth-bound) separately over the decode-compatible subset
@@ -189,6 +195,14 @@ def generate_strategies(world_size: int, args: SearchArgs) -> List[list]:
                                 if cp > 1:
                                     info["cp"] = cp
                                 strategies.append([pp, tp, dp, info])
+                                # remat-policy variant: a checkpointed layer
+                                # that pins its dot outputs recomputes only
+                                # the cheap tail — more memory than full
+                                # remat, less backward time
+                                if args.remat_search and cpt:
+                                    rinfo = dict(info)
+                                    rinfo["rp"] = "dots_saveable"
+                                    strategies.append([pp, tp, dp, rinfo])
                                 # comm-precision variant (ROADMAP item 2):
                                 # only where the quantized ring can run —
                                 # pure data parallel with a dp group to talk
@@ -353,6 +367,10 @@ class GalvatronSearchEngine:
                     other_memory_pp_off=self.memory_config.get("other_memory_pp_off", {}),
                     other_memory_pp_on=self.memory_config.get("other_memory_pp_on", {}),
                     other_time_profiled=self.time_config.get("other_time", 1.0),
+                    # measured per-policy recompute fractions (profiler's
+                    # profile_remat output); None -> analytic table
+                    remat_recompute_frac=self.time_config.get(
+                        "remat_recompute_frac"),
                 )
             )
             pha_list.append(
@@ -791,6 +809,7 @@ class GalvatronSearchEngine:
                     tp_consec=info.get("tp", 1),
                     grad_comm_dtype=info.get("gcd", "none"),
                     param_comm_dtype=info.get("pcd", "none"),
+                    remat_policy=info.get("rp", "full"),
                 )
             )
         return HybridParallelConfig(

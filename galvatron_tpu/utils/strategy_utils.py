@@ -29,6 +29,10 @@ def form_strategy(strategy):
         tag += "-fsdp"
     if info.get("cpt", info.get("ckpt", 0)):
         tag += "-ckpt"
+        # remat-policy axis: a non-default policy changes both the memory
+        # and the time cost, so it is part of the cache identity too
+        if info.get("rp", "full") != "full":
+            tag += "[%s]" % info["rp"]
     # comm-precision axis (quantized collectives): part of the identity —
     # the cost-model caches key on this string
     if info.get("gcd", "none") != "none":

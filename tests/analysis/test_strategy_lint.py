@@ -357,3 +357,56 @@ def test_autotune_margin_inert_without_mode_warns_gls103():
     # ... and is clean when the tuner is actually on
     report2 = S.lint_hp(_dp8(), autotune="apply", autotune_margin=0.1)
     assert "GLS103" not in report2.codes(), report2.render()
+
+
+# -------------------------------------- per-layer remat search (ISSUE 15)
+def test_remat_mixed_fixture_is_clean():
+    """A searched mixed per-layer remat plan is a first-class citizen of the
+    valid corpus: no warning for deviating from the global default."""
+    report = lint("valid/remat_mixed.json")
+    assert report.ok and not report.warnings, report.render()
+
+
+def test_remat_all_full_key_warns_gls103():
+    """Serialized remat_policy of all-'full' carries no information beyond
+    the checkpoint flag — the key should be dropped."""
+    report = lint("warn/gls103_remat_full_key.json")
+    assert report.ok, report.render()
+    warns = [d for d in report.warnings if d.code == "GLS103"]
+    assert warns and any(d.key == "remat_policy" for d in warns), report.render()
+
+
+def test_remat_global_flag_shadowed_warns_gls103():
+    """Precedence rule: serialized per-layer policies win; a non-default
+    --remat_policy flag over a JSON that carries the key was shadowed."""
+    report = lint("valid/remat_mixed.json", remat_policy="dots_saveable")
+    assert report.ok, report.render()
+    msgs = [d.message for d in report.warnings if d.code == "GLS103"]
+    assert any("shadowed" in m for m in msgs), report.render()
+    # the default flag value never warns
+    assert not lint("valid/remat_mixed.json", remat_policy="full").warnings
+
+
+def test_remat_bad_value_is_gls005():
+    report = S.lint_strategy_dict(
+        {"pp_deg": 1, "tp_sizes_enc": "1,1,1,1", "dp_types_enc": "0,0,0,0",
+         "checkpoint": "1,1,1,1", "remat_policy": "none,none,bogus,none",
+         "global_bsz": 8}, WORLD)
+    assert not report.ok and "GLS005" in report.codes(), report.render()
+
+
+def test_remat_policy_prices_into_memory_estimate():
+    """dots_saveable holds strictly less than full (activations shrink to
+    the dot outputs) and strictly more than none on checkpointed layers."""
+    from galvatron_tpu.config.strategy import HybridParallelConfig
+
+    def est(rp):
+        hp = HybridParallelConfig.from_json(
+            {"pp_deg": 1, "tp_sizes_enc": "1,1,1,1",
+             "dp_types_enc": "0,0,0,0", "checkpoint": "1,1,1,1",
+             "remat_policy": ",".join([rp] * 4), "global_bsz": 8},
+            world_size=WORLD)
+        return sum(S.estimate_stage_memory_mb(hp, MODEL))
+
+    full, dots, none = est("full"), est("dots_saveable"), est("none")
+    assert full < dots < none, (full, dots, none)

@@ -77,8 +77,12 @@ def test_unknown_family_raises():
 
 def test_compilation_flags_default_and_plumbing(tmp_path):
     """--no_scan_layers / --remat_policy reach HybridParallelConfig on both
-    the GLOBAL-flags path and the searched-JSON path (they are runtime
-    execution knobs, never part of the on-disk strategy schema)."""
+    the GLOBAL-flags path and the searched-JSON path. scan_layers is a pure
+    runtime execution knob (never on-disk); remat_policy is a SERIALIZED
+    per-layer strategy field since the remat search dimension — the flag is
+    a default-override that FILLS layers when the JSON lacks the key."""
+    import dataclasses
+
     args = initialize_galvatron(mode="train_dist", argv=[])
     assert args.scan_layers is True and args.remat_policy == "full"
     assert args.compile_cache == 0
@@ -90,6 +94,7 @@ def test_compilation_flags_default_and_plumbing(tmp_path):
     ])
     hp = hp_config_from_args(args, num_layers=2, world_size=8)
     assert hp.scan_layers is False and hp.remat_policy == "dots_saveable"
+    assert all(s.remat_policy == "dots_saveable" for s in hp.layers)
 
     from galvatron_tpu.config.strategy import HybridParallelConfig
 
@@ -97,13 +102,45 @@ def test_compilation_flags_default_and_plumbing(tmp_path):
     p = tmp_path / "strategy.json"
     ref.save(str(p))
     assert "scan_layers" not in ref.to_json_dict()
+    assert "remat_policy" not in ref.to_json_dict()  # all-"full": no key
     args = initialize_galvatron(mode="train_dist", argv=[
         "--galvatron_config_path", str(p), "--no_scan_layers",
         "--remat_policy", "nothing_saveable", "--global_train_batch_size", "8",
     ])
     hp = hp_config_from_args(args, num_layers=2, world_size=8)
     assert hp.scan_layers is False and hp.remat_policy == "nothing_saveable"
-    hp.assert_equal(ref)  # execution knobs don't change strategy identity
+    # the JSON carries no remat_policy key, so the flag filled every layer
+    assert all(s.remat_policy == "nothing_saveable" for s in hp.layers)
+    # scan_layers never touches strategy identity; the filled remat policies
+    # DO (they serialize) — neutralized, the rest of the identity matches
+    neutral = dataclasses.replace(
+        hp, remat_policy="full",
+        layers=[dataclasses.replace(s, remat_policy="full")
+                for s in hp.layers])
+    neutral.assert_equal(ref)
+
+
+def test_remat_policy_serialized_values_win_over_flag(tmp_path):
+    """Precedence rule (ISSUE 15): a JSON that carries per-layer remat
+    policies keeps them verbatim — the global flag does not overwrite."""
+    import dataclasses
+
+    from galvatron_tpu.config.strategy import HybridParallelConfig
+
+    ref = HybridParallelConfig.uniform(
+        world_size=8, num_layers=2, tp=2, checkpoint=1, global_bsz=8)
+    ref = dataclasses.replace(ref, layers=[
+        dataclasses.replace(s, remat_policy=rp)
+        for s, rp in zip(ref.layers, ("none", "dots_saveable"))])
+    p = tmp_path / "strategy.json"
+    ref.save(str(p))
+    assert "remat_policy" in ref.to_json_dict()
+    args = initialize_galvatron(mode="train_dist", argv=[
+        "--galvatron_config_path", str(p),
+        "--remat_policy", "nothing_saveable", "--global_train_batch_size", "8",
+    ])
+    hp = hp_config_from_args(args, num_layers=2, world_size=8)
+    assert [s.remat_policy for s in hp.layers] == ["none", "dots_saveable"]
 
 
 def test_tp_comm_mode_flag_plumbing(tmp_path):

@@ -316,3 +316,119 @@ def test_driver_elastic_search_resume_8_to_4(devices8, tmp_path):
     assert len(resumed["losses"]) == 2
     np.testing.assert_allclose(
         resumed["losses"], full["losses"][2:], rtol=5e-3, atol=2e-4)
+
+
+# ----------------------------------------- per-layer remat plans (ISSUE 15)
+def test_cross_layout_resume_keeps_remat_plan(devices8, tmp_path):
+    """A checkpoint saved under a MIXED per-layer remat plan restores
+    bitwise across a layout change (tp=1 -> tp=2), and the restored run
+    keeps the per-layer plan — through the provenance round-trip on the
+    matching-world path, and through the strategy-file path whose target
+    carries its own plan. The driver's global --remat_policy default (args
+    arrive with 'full') must not overwrite either."""
+    import dataclasses
+
+    cfg = tiny_cfg()
+
+    def with_plan(hp):
+        return dataclasses.replace(hp, layers=[
+            dataclasses.replace(s, checkpoint=c, remat_policy=rp)
+            for s, (c, rp) in zip(hp.layers, [
+                (1, "dots_saveable"), (1, "dots_saveable"),
+                (1, "full"), (0, "full")])])
+
+    hp_a = with_plan(HybridParallelConfig.uniform(8, 4, global_bsz=8))
+    m_a, tx, p_a, st_a = build(cfg, hp_a, devices8)
+    d = save_with_provenance(tmp_path, cfg, hp_a, m_a, p_a, st_a)
+
+    class A:
+        load = d
+        elastic = "search"
+        elastic_strategy = None
+        elastic_memory_gb = None
+        mixed_precision = "fp32"
+        model_type = "llama"
+        config_dir = None
+        remat_policy = "full"  # the CLI default: a fill, never an overwrite
+
+    plan = els.resolve_resume_strategy(A(), cfg, 8)
+    assert plan.action == "match"
+    assert [s.effective_remat_policy for s in plan.hp.layers] == \
+        ["dots_saveable", "dots_saveable", "full", "none"]
+
+    # cross-layout leg: a tp=2 target carrying the same per-layer plan
+    hp_b = with_plan(HybridParallelConfig.uniform(8, 4, tp=2, global_bsz=8))
+    spath = str(tmp_path / "target.json")
+    hp_b.save(spath)
+
+    class B(A):
+        elastic = "resume"
+        elastic_strategy = spath
+
+    plan_b = els.resolve_resume_strategy(B(), cfg, 8)
+    assert plan_b.action == "strategy_file" and plan_b.cross_strategy
+    assert [s.effective_remat_policy for s in plan_b.hp.layers] == \
+        ["dots_saveable", "dots_saveable", "full", "none"]
+    m_b = construct_hybrid_parallel_model(cfg, plan_b.hp, devices8)
+    p_got, st_got, _ = ck.load_checkpoint(d, target=m_b, tx=tx,
+                                          strict_strategy=False)
+    assert_global_params_equal(p_got, p_a)
+
+
+def test_autotune_replan_ladder_trades_chunks_against_remat():
+    """The autotuner's re-plan recipe (measured tables through
+    search_surviving_strategy with settle_chunk=None) walks a budget
+    ladder: loose budgets keep chunks=1; squeezing the budget makes the
+    remat-off planner buy memory with MORE CHUNKS, while the remat axis
+    lets the planner keep chunks=1 by checkpointing a few layers with the
+    cheaper dots_saveable policy instead — chunks and remat are one
+    trade, which is why the re-plan must search them together. Pure
+    python DP over mock measured tables, milliseconds."""
+    from types import SimpleNamespace
+
+    time_cfg = {"layertype_0": 5.3, "other_time": 2.0}
+    mem_cfg = {
+        "layertype_0": {
+            "parameter_size": 96.0,
+            "tp_activation_per_bsz_dict": {
+                1: 500.0, 2: 260.0, 4: 140.0, 8: 80.0, "checkpoint": 30.0},
+        },
+        "other_memory_pp_off": {
+            "model_states": {1: 3000.0, 2: 1500.0, 4: 750.0, 8: 375.0},
+            "activation": {1: 80.0, 2: 42.0, 4: 22.0, 8: 12.0},
+        },
+        "other_memory_pp_on": {
+            "first_stage": {
+                "model_states": {1: 2000.0, 2: 1000.0, 4: 500.0, 8: 250.0},
+                "activation": {1: 50.0, 2: 26.0, 4: 14.0, 8: 8.0}},
+            "last_stage": {
+                "model_states": {1: 1500.0, 2: 750.0, 4: 375.0, 8: 190.0},
+                "activation": {1: 30.0, 2: 16.0, 4: 8.0, 8: 5.0}},
+        },
+    }
+    cfg = SimpleNamespace(num_heads=1, num_layers=8, max_seq_len=2048,
+                          hidden_size=4096)
+
+    def replan(gb, remat_search):
+        return els.search_surviving_strategy(
+            cfg, 8, 16, gb, time_config=time_cfg, memory_config=mem_cfg,
+            remat_search=remat_search)
+
+    # loose budget: nothing to trade — chunks=1, no checkpoints, either way
+    for rs in (False, True):
+        hp = replan(12.0, rs)
+        assert hp.chunks == 1
+        assert all(s.checkpoint == 0 for s in hp.layers)
+
+    # tight budget, remat off: the re-plan CHANGES CHUNKS to fit
+    hp_off = replan(8.0, False)
+    assert hp_off.chunks == 2
+    assert all(s.checkpoint == 0 for s in hp_off.layers)
+
+    # same budget, remat on: a mixed dots_saveable plan is cheaper than
+    # chunking — the re-plan keeps chunks=1 and checkpoints a slice
+    hp_on = replan(8.0, True)
+    assert hp_on.chunks == 1
+    eff = [s.effective_remat_policy for s in hp_on.layers]
+    assert "dots_saveable" in eff and "none" in eff
+    assert 0 < sum(s.checkpoint for s in hp_on.layers) < len(hp_on.layers)

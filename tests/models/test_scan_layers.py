@@ -83,6 +83,27 @@ class TestLayerRuns:
         hp = HybridParallelConfig(world_size=8, pp=1, layers=layers, global_bsz=8)
         assert [r.length for r in layer_runs(hp)] == [1, 1, 1, 1]
 
+    def test_remat_policy_partitions(self):
+        # same axes, same checkpoint flag — a differing per-layer remat
+        # policy still wraps the scanned body in a different jax.checkpoint
+        # program, so it must split the run
+        layers = ([LayerStrategy(checkpoint=1, remat_policy="dots_saveable")] * 2
+                  + [LayerStrategy(checkpoint=1)] * 2)
+        hp = HybridParallelConfig(world_size=8, pp=1, layers=layers, global_bsz=8)
+        runs = layer_runs(hp)
+        assert [(r.start, r.stop) for r in runs] == [(0, 2), (2, 4)]
+        assert [r.strategy.effective_remat_policy for r in runs] == \
+            ["dots_saveable", "full"]
+
+    def test_remat_policy_inert_without_checkpoint(self):
+        # checkpoint=0 layers never wrap: their serialized policy is inert,
+        # and cpt=1 + rp='none' is effectively cpt=0 — one run throughout
+        layers = [LayerStrategy(remat_policy="dots_saveable"),
+                  LayerStrategy(remat_policy="nothing_saveable"),
+                  LayerStrategy(checkpoint=1, remat_policy="none")]
+        hp = HybridParallelConfig(world_size=8, pp=1, layers=layers, global_bsz=8)
+        assert len(layer_runs(hp)) == 1
+
 
 # ------------------------------------------------------------------ parity
 # uniform: one run of 4; piecewise: runs of 2+2; hetero: four length-1 runs
@@ -172,8 +193,9 @@ def test_scan_layers_escape_hatch(devices8):
 
 @pytest.mark.parametrize("policy", ["none", "full", "dots_saveable", "nothing_saveable"])
 def test_remat_policy_parity(policy, devices8):
-    """Every remat policy computes the same loss/grads as the default; the
-    policy only moves the memory/recompute tradeoff."""
+    """Every remat policy computes the same loss/grads as the default, on
+    BOTH execution paths — the scanned run body and the per-layer unrolled
+    wrap; the policy only moves the memory/recompute tradeoff."""
     cfg = make_cfg(4)
     hp = HybridParallelConfig.uniform(
         8, 4, tp=2, checkpoint=1, global_bsz=B, remat_policy=policy,
@@ -184,15 +206,52 @@ def test_remat_policy_parity(policy, devices8):
     ref_hp = HybridParallelConfig.uniform(8, 4, tp=2, checkpoint=1, global_bsz=B)
     ref, ref_g = _loss_and_grads(cfg, ref_hp, mesh, params, x, positions, scan=True)
     got, got_g = _loss_and_grads(cfg, hp, mesh, params, x, positions, scan=True)
+    got_u, got_ug = _loss_and_grads(cfg, hp, mesh, params, x, positions, scan=False)
     assert abs(float(ref) - float(got)) < 1e-6, policy
-    for a, b in zip(jax.tree.leaves(ref_g), jax.tree.leaves(got_g)):
+    assert abs(float(got) - float(got_u)) < 1e-6, policy
+    for a, b, c in zip(jax.tree.leaves(ref_g), jax.tree.leaves(got_g),
+                       jax.tree.leaves(got_ug)):
         assert float(jnp.max(jnp.abs(a - b))) < 1e-5, policy
+        assert float(jnp.max(jnp.abs(b - c))) < 1e-5, policy
+
+
+def test_remat_mixed_policy_piecewise_parity(devices8):
+    """A MIXED per-layer remat plan (the searched shape: some layers under
+    dots_saveable, some full, some unwrapped) splits into piecewise runs and
+    still computes the default's loss/grads on both execution paths."""
+    import dataclasses
+
+    cfg = make_cfg(4)
+    hp = HybridParallelConfig.uniform(8, 4, tp=2, global_bsz=B)
+    hp = dataclasses.replace(hp, layers=[
+        dataclasses.replace(s, checkpoint=c, remat_policy=rp)
+        for s, (c, rp) in zip(hp.layers, [
+            (1, "dots_saveable"), (1, "dots_saveable"), (1, "full"),
+            (0, "full")])])
+    runs = layer_runs(hp)
+    assert [(r.start, r.stop) for r in runs] == [(0, 2), (2, 3), (3, 4)]
+    assert [r.strategy.effective_remat_policy for r in runs] == \
+        ["dots_saveable", "full", "none"]
+    mesh = build_mesh(hp, devices8)
+    params = make_layers(cfg)
+    x, positions = make_inputs()
+    ref_hp = HybridParallelConfig.uniform(8, 4, tp=2, global_bsz=B)
+    ref, ref_g = _loss_and_grads(cfg, ref_hp, mesh, params, x, positions, scan=True)
+    got, got_g = _loss_and_grads(cfg, hp, mesh, params, x, positions, scan=True)
+    got_u, got_ug = _loss_and_grads(cfg, hp, mesh, params, x, positions, scan=False)
+    assert abs(float(ref) - float(got)) < 1e-6
+    assert abs(float(got) - float(got_u)) < 1e-6
+    for a, b, c in zip(jax.tree.leaves(ref_g), jax.tree.leaves(got_g),
+                       jax.tree.leaves(got_ug)):
+        assert float(jnp.max(jnp.abs(a - b))) < 1e-5
+        assert float(jnp.max(jnp.abs(b - c))) < 1e-5
 
 
 def test_remat_policy_validated():
-    from galvatron_tpu.analysis.diagnostics import DiagnosticError
-
-    with pytest.raises(DiagnosticError):
+    # the per-layer field validates eagerly in LayerStrategy.__post_init__
+    # (remat_policy is a serialized strategy field since the remat search
+    # dimension), so a bogus value dies before the GLS005 layer ever runs
+    with pytest.raises(ValueError, match="remat_policy"):
         HybridParallelConfig.uniform(8, 2, remat_policy="bogus")
 
 

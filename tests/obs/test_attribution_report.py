@@ -240,3 +240,40 @@ def test_report_autotuning_rollup_from_golden():
     # a stream with no autotune events carries no section
     rest = [e for e in events if e["type"] != "autotune"]
     assert "autotuning" not in R.analyze(rest)
+
+
+def test_predict_layer_runs_prices_chunks_and_remat():
+    """ISSUE 15: the prediction is chunks-aware — per-MICROBATCH layer cost
+    times the schedule's tick count, so at pp=1 a chunked run prices the
+    fill/drain it pays without pipeline stages to amortize it — and
+    checkpointed runs carry the remat axis (the policy plus the recompute
+    toll the cost model charged), every row a schema-valid layer_run event."""
+    import dataclasses
+
+    cfg = tiny_cfg()
+    by_chunks = {}
+    for chunks in (1, 4):
+        hp = HybridParallelConfig.uniform(8, 4, global_bsz=8, chunks=chunks)
+        by_chunks[chunks] = A.predict_layer_runs(cfg, hp)[0]
+    assert by_chunks[4]["predicted_ms"] > by_chunks[1]["predicted_ms"]
+
+    hp = HybridParallelConfig.uniform(8, 4, global_bsz=8, checkpoint=1)
+    hp = dataclasses.replace(hp, layers=[
+        dataclasses.replace(s, remat_policy=rp) for s, rp in zip(
+            hp.layers, ("none", "none", "dots_saveable", "dots_saveable"))])
+    preds = A.predict_layer_runs(cfg, hp)
+    rows = [p for p in preds if p["run"] != A.HEAD_RUN]
+    assert [r["strategy"] for r in rows] == \
+        ["tp1 cp1 dp8 ckpt[none]", "tp1 cp1 dp8 ckpt[dots_saveable]"]
+    # cpt=1 + rp=none is remat-free: no remat columns, cheaper than dots
+    assert "remat_policy" not in rows[0] and "predicted_recompute_ms" not in rows[0]
+    assert rows[1]["remat_policy"] == "dots_saveable"
+    assert rows[1]["predicted_recompute_ms"] > 0
+    assert rows[1]["predicted_ms"] > rows[0]["predicted_ms"]
+    sink = T.MemorySink()
+    for p in preds:
+        sink.emit("layer_run", **p)
+    # the remat columns surface in the rendered divergence table
+    table = A.render_divergence_table(
+        A.divergence_rows(preds, measured_step_ms=100.0))
+    assert "remat" in table and "rc_ms" in table

@@ -301,3 +301,126 @@ def test_comm_dtype_survives_migration_resolution(tmp_path):
     assert action == "strategy_file"
     assert all(s.grad_comm_dtype == "int8" for s in hp.layers)
     assert all(s.param_comm_dtype == "int8" for s in hp.layers)
+
+
+# ------------------------------------------- per-layer remat (ISSUE 15)
+def test_remat_policy_round_trips_json_and_provenance():
+    """remat_policy is a SERIALIZED per-layer strategy field (like the comm
+    dtypes): save -> from_json -> save is the identity, and elastic
+    provenance built from the config carries the mixed plan."""
+    from galvatron_tpu.config.strategy import (
+        HybridParallelConfig,
+        LayerStrategy,
+        layer_runs,
+    )
+
+    layers = [
+        LayerStrategy(checkpoint=1, remat_policy="dots_saveable"),
+        LayerStrategy(checkpoint=1, remat_policy="dots_saveable"),
+        LayerStrategy(checkpoint=1),  # full (the checkpoint default)
+        LayerStrategy(),              # not checkpointed
+    ]
+    hp = HybridParallelConfig(world_size=8, pp=1, layers=layers, global_bsz=8)
+    d = hp.to_json_dict()
+    assert d["remat_policy"] == "dots_saveable,dots_saveable,full,full"
+    hp2 = HybridParallelConfig.from_json(d, world_size=8)
+    assert hp2.to_json_dict() == d
+    hp2.assert_equal(hp)
+    # effective policy partitions the runs: [dots, dots] | [full] | [none]
+    assert [(r.start, r.stop) for r in layer_runs(hp2)] == [(0, 2), (2, 3), (3, 4)]
+    assert [r.strategy.effective_remat_policy for r in layer_runs(hp2)] == \
+        ["dots_saveable", "full", "none"]
+
+    import types
+
+    from galvatron_tpu.runtime.elastic import build_provenance
+
+    prov = build_provenance(hp, model_cfg=types.SimpleNamespace(hidden_size=8))
+    hp3 = HybridParallelConfig.from_json(dict(prov["strategy"]), world_size=8)
+    assert [s.remat_policy for s in hp3.layers] == \
+        [s.remat_policy for s in hp.layers]
+
+
+def test_remat_inert_differences_do_not_split_runs():
+    """The run splitter keys on the EFFECTIVE policy: a remat_policy on a
+    checkpoint=0 layer is inert, and checkpoint=1 with remat_policy='none'
+    executes exactly like checkpoint=0 — neither forks a scan program."""
+    from galvatron_tpu.config.strategy import (
+        HybridParallelConfig,
+        LayerStrategy,
+        layer_runs,
+    )
+
+    hp = HybridParallelConfig(
+        world_size=8, pp=1,
+        layers=[LayerStrategy(remat_policy="dots_saveable"),
+                LayerStrategy(),
+                LayerStrategy(checkpoint=1, remat_policy="none")],
+        global_bsz=8)
+    assert len(layer_runs(hp)) == 1
+
+
+def test_remat_absent_key_defaults_and_override():
+    """Pre-ISSUE-15 JSONs (no remat_policy key) load as 'full' everywhere;
+    the global-flag override fills them — but ONLY when the key is absent
+    (serialized per-layer values always win, see test_arguments.py for the
+    CLI half of the precedence rule)."""
+    from galvatron_tpu.config.strategy import HybridParallelConfig
+
+    base = {"pp_deg": 1, "tp_sizes_enc": "1,1", "dp_types_enc": "0,0",
+            "checkpoint": "1,1", "global_bsz": 8}
+    hp = HybridParallelConfig.from_json(base, world_size=8)
+    assert all(s.remat_policy == "full" for s in hp.layers)
+    hp = HybridParallelConfig.from_json(
+        base, world_size=8, remat_policy="dots_saveable")
+    assert all(s.remat_policy == "dots_saveable" for s in hp.layers)
+    hp = HybridParallelConfig.from_json(
+        dict(base, remat_policy="none,full"), world_size=8,
+        remat_policy="dots_saveable")
+    assert [s.remat_policy for s in hp.layers] == ["none", "full"]
+
+
+def test_remat_bad_enum_and_length_rejected():
+    from galvatron_tpu.analysis.diagnostics import DiagnosticError
+    from galvatron_tpu.config.strategy import HybridParallelConfig
+
+    with pytest.raises(DiagnosticError, match="GLS005"):
+        HybridParallelConfig.from_json(
+            {"pp_deg": 1, "tp_sizes_enc": "1,1", "dp_types_enc": "0,0",
+             "remat_policy": "dots_savable,full", "global_bsz": 8},
+            world_size=8)
+    with pytest.raises(DiagnosticError, match="GLS006"):
+        HybridParallelConfig.from_json(
+            {"pp_deg": 1, "tp_sizes_enc": "1,1", "dp_types_enc": "0,0",
+             "remat_policy": "full", "global_bsz": 8}, world_size=8)
+
+
+def test_remat_plan_survives_migration_resolution(tmp_path):
+    """A mixed per-layer remat plan resolves as a live-migration target with
+    the plan intact — the hot-swap rebuilds the train step under the same
+    per-layer policies the search chose."""
+    import argparse
+    import json
+
+    from galvatron_tpu.config.strategy import HybridParallelConfig
+    from galvatron_tpu.models.base import TransformerConfig
+    from galvatron_tpu.runtime.elastic import resolve_migration_strategy
+
+    cfg = TransformerConfig(hidden_size=64, num_heads=4, num_layers=2,
+                            vocab_size=128, max_seq_len=32)
+    current = HybridParallelConfig.uniform(8, 2, tp=2, global_bsz=8)
+    import dataclasses
+
+    target = HybridParallelConfig.uniform(8, 2, tp=1, global_bsz=8)
+    target = dataclasses.replace(target, layers=[
+        dataclasses.replace(s, checkpoint=c, remat_policy=rp)
+        for s, (c, rp) in zip(
+            target.layers, [(1, "dots_saveable"), (0, "full")])])
+    path = tmp_path / "target.json"
+    path.write_text(json.dumps(target.to_json_dict()))
+    args = argparse.Namespace(elastic_strategy=str(path),
+                              elastic_memory_gb=1024.0)
+    hp, action = resolve_migration_strategy(args, cfg, 8, current)
+    assert action == "strategy_file"
+    assert [s.effective_remat_policy for s in hp.layers] == \
+        ["dots_saveable", "none"]

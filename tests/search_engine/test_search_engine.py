@@ -459,3 +459,52 @@ def test_comm_quant_off_leaves_space_unchanged():
                and not s[3].get("sp") for s in quant)
     # zero3 variants carry the quantized param gather too
     assert any(s[3].get("fsdp") and s[3].get("pcd") == "int8" for s in quant)
+
+
+# ------------------------------------------- remat search axis (ISSUE 15)
+def test_remat_search_variants_generated():
+    """remat_search adds a dots_saveable variant for every checkpointed
+    strategy — and ONLY those (none ≡ cpt=0 is already in the space, full
+    is the cpt=1 default, nothing_saveable prices like full)."""
+    base = generate_strategies(8, SearchArgs())
+    remat = generate_strategies(8, SearchArgs(remat_search=True))
+    extra = [s for s in remat if s[3].get("rp")]
+    assert extra and all(s[3]["rp"] == "dots_saveable" for s in extra)
+    assert all(s[3].get("cpt", s[3].get("ckpt", 0)) for s in extra)
+    assert len(remat) == len(base) + len(extra)
+
+
+def test_remat_search_steering_by_budget(tmp_path):
+    """Loose budget: remat never engages (the plan matches the remat-off
+    search). Tight budget infeasible for all-none: the DP mixes per-layer
+    dots_saveable checkpointing and beats the full-remat-only search's
+    cost — and the emitted mixed plan round-trips through the on-disk JSON
+    and lints clean."""
+    from galvatron_tpu.analysis import strategy_lint as SL
+
+    def plan(result):
+        return [(s[3].get("cpt", s[3].get("ckpt", 0)),
+                 s[3].get("rp", "full")) for s in result["strategies"]]
+
+    # loose: nothing checkpoints, so the remat axis stays untouched
+    loose = make_engine(mem_gb=24.0, remat_search=True).parallelism_optimization()
+    assert all(c == 0 for c, _ in plan(loose))
+
+    # tight: all-none is infeasible (the no-ckpt engine of the same budget
+    # must checkpoint), and the remat-aware DP finds a cheaper MIXED plan
+    tight_off = make_engine(mem_gb=5.0).parallelism_optimization()
+    tight_on_eng = make_engine(mem_gb=5.0, remat_search=True)
+    tight_on = tight_on_eng.parallelism_optimization()
+    assert any(c for c, _ in plan(tight_off))  # budget forces checkpointing
+    cpts = [c for c, _ in plan(tight_on)]
+    assert 0 < sum(cpts) < len(cpts), plan(tight_on)  # mixed, not uniform
+    assert any(rp == "dots_saveable" for c, rp in plan(tight_on) if c)
+    assert tight_on["cost"] <= tight_off["cost"] + 1e-9
+
+    # the mixed plan is a first-class on-disk strategy
+    path = tight_on_eng.save_results(tight_on, str(tmp_path / "mixed.json"))
+    cfg = HybridParallelConfig.from_json(path, world_size=8)
+    policies = [s.effective_remat_policy for s in cfg.layers]
+    assert "dots_saveable" in policies and "none" in policies
+    report = SL.lint_strategy_file(path, 8)
+    assert report.ok and not report.warnings, report.render()

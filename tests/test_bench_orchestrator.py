@@ -81,6 +81,9 @@ def test_mfu_regression_gate_exit_codes(tmp_path):
             "sdc_overhead": {"off": {"step_ms": 8.0},
                              "digest": {"step_ms": 8.1},
                              "vote": {"step_ms": 9.0}},
+            "remat": {"none": {"step_ms": 40.0},
+                      "full": {"step_ms": 55.0},
+                      "searched": {"step_ms": 42.0, "peak_mb": 5.0}},
             "autotune": {"misspecified": {"steps_per_s": 10.0},
                          "converged": {"steps_per_s": 12.0}}}}}
     empty_round = {"n": 4, "parsed": None}  # wedged round: tolerated, skipped
@@ -89,7 +92,7 @@ def test_mfu_regression_gate_exit_codes(tmp_path):
 
     def run_gate(mfu, gate="1", overlap_step_ms=9.0, quant_step_ms=22.0,
                  serve_tps=64.0, serve_step_ms=2.0, sdc_digest_step_ms=8.1,
-                 autotune_converged_sps=12.0):
+                 remat_searched_step_ms=42.0, autotune_converged_sps=12.0):
         fake = tmp_path / "fake.json"
         fake.write_text(json.dumps({"results": {
             "train_step": {"mfu": mfu, "tokens_per_sec_per_chip": 30000.0},
@@ -105,6 +108,10 @@ def test_mfu_regression_gate_exit_codes(tmp_path):
             "sdc_overhead": {"off": {"step_ms": 8.0},
                              "digest": {"step_ms": sdc_digest_step_ms},
                              "vote": {"step_ms": 9.0}},
+            "remat": {"none": {"step_ms": 40.0},
+                      "full": {"step_ms": 55.0},
+                      "searched": {"step_ms": remat_searched_step_ms,
+                                   "peak_mb": 5.0}},
             "autotune": {"misspecified": {"steps_per_s": 10.0},
                          "converged": {"steps_per_s": autotune_converged_sps}}}}))
         env = dict(os.environ,
@@ -142,6 +149,12 @@ def test_mfu_regression_gate_exit_codes(tmp_path):
     p = run_gate(0.4, sdc_digest_step_ms=10.0)
     assert p.returncode == 1, p.stdout
     assert "sdc_overhead.digest.step_ms" in p.stdout
+    # the searched remat plan's step time is gated too (ISSUE 15): a mixed
+    # plan that decays toward the all-full step time is a regression even
+    # with every other number healthy
+    p = run_gate(0.4, remat_searched_step_ms=50.0)
+    assert p.returncode == 1, p.stdout
+    assert "remat.searched.step_ms" in p.stdout
     # the autotuner's post-swap throughput is gated too (ISSUE 14): a
     # converged strategy that stops beating the mis-specified start is a
     # regression even with every other number healthy
