@@ -7,6 +7,12 @@ before any device time is spent.
   and world size with no device or tracing work (GLS*** codes).
 - `code_lint`: AST pass over the package flagging jax-API drift and
   jit-safety hazards (GLC*** codes).
+- `ckpt_lint`: offline checkpoint-directory audit (GLS21x codes).
+- `trace_lint`: abstract-evals the train step to a ClosedJaxpr (no compile)
+  and walks it for the pinned GSPMD miscompile classes, donation waste,
+  manual-region hazards and predicted-vs-traced collective drift
+  (GLT*** codes; the WA*** workaround inventory lives in
+  `utils/jax_compat.py`).
 
 The package __init__ stays import-light (the config layer imports
 `analysis.diagnostics` from inside `HybridParallelConfig.validate`); the
@@ -23,7 +29,7 @@ from galvatron_tpu.analysis.diagnostics import (  # noqa: F401
     registry_table,
 )
 
-_LAZY = {"strategy_lint", "code_lint"}
+_LAZY = {"strategy_lint", "code_lint", "ckpt_lint", "trace_lint"}
 
 
 def __getattr__(name):

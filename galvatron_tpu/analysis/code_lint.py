@@ -46,6 +46,14 @@ A pure-AST pass (no execution of the linted code) over Python sources:
   names assigned from ``jax.jit(...)``-wrapped callables and
   ``jax.device_put``, so plain host-numpy ``float()`` loops don't trip it;
   ``block_until_ready`` is a sync by definition and is flagged untainted.
+- **GLC007 — custom_vjp closing over a traced axis_index**: a custom_vjp
+  primal or ``defvjp`` rule that reads, as a free variable, a name bound
+  from ``jax.lax.axis_index`` in an enclosing scope. Inside a shard_map
+  region the index is a per-shard traced value; baked into the rule's
+  closure, the legacy shard_map transpose replays it with the wrong
+  shard's value (the tp ring cotangent hazard ``parallel/tp_shard_map.py``
+  documents) — recompute ``axis_index`` inside the rule instead. The
+  traced-program linter's GLT005 catches the same bug at jaxpr level.
 
 Jit contexts are found both as decorators (``@jax.jit``,
 ``@partial(jax.jit, ...)``) and as wrappings of a locally-defined function
@@ -582,6 +590,106 @@ class _ModuleLint:
                         file=self.filename, line=node.lineno, key="open",
                     ))
 
+    # ---- GLC007 --------------------------------------------------------
+    def _axis_index_names(self, scope) -> Set[str]:
+        """Names bound in `scope`'s own body (nested functions excluded)
+        from a call to jax.lax.axis_index."""
+        out: Set[str] = set()
+        for node in self._walk_scope(scope):
+            if not (isinstance(node, ast.Assign) and isinstance(node.value, ast.Call)):
+                continue
+            chain = _attr_chain(node.value.func)
+            if not chain:
+                continue
+            rooted = self.aliases.jax.get(chain[0])
+            if rooted is None:
+                continue
+            if (rooted + tuple(chain[1:]))[-1] == "axis_index":
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+        return out
+
+    @staticmethod
+    def _locally_bound(fn) -> Set[str]:
+        a = fn.args
+        bound = {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
+        if isinstance(fn, ast.Lambda):
+            return bound
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+                bound.add(n.id)
+        return bound
+
+    def check_custom_vjp_closures(self):
+        """GLC007: a custom_vjp primal or vjp rule reads, as a free
+        variable, a name its enclosing scope bound from jax.lax.axis_index.
+        Inside a shard_map region that index is a per-shard traced value;
+        closing over it bakes it into the rule's closure, where the legacy
+        shard_map transpose replays it wrong (the PR-8 tp ring hazard).
+        Recompute axis_index inside the rule instead."""
+        if "GLC007" not in self.rules:
+            return
+        # vjp-rule surface: f.defvjp(fwd, bwd) args, f = jax.custom_vjp(g)
+        # operands, and @jax.custom_vjp-decorated primals
+        vjp_names: Set[str] = set()
+        vjp_lambdas: Set[int] = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call):
+                if isinstance(node.func, ast.Attribute) and node.func.attr == "defvjp":
+                    for a in node.args:
+                        if isinstance(a, ast.Name):
+                            vjp_names.add(a.id)
+                        elif isinstance(a, ast.Lambda):
+                            vjp_lambdas.add(id(a))
+                else:
+                    chain = _attr_chain(node.func)
+                    if (chain and chain[-1] == "custom_vjp"
+                            and self.aliases.jax.get(chain[0])
+                            and node.args and isinstance(node.args[0], ast.Name)):
+                        vjp_names.add(node.args[0].id)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    target = dec.func if isinstance(dec, ast.Call) else dec
+                    chain = _attr_chain(target)
+                    if (chain and chain[-1] == "custom_vjp"
+                            and self.aliases.jax.get(chain[0])):
+                        vjp_names.add(node.name)
+        if not vjp_names and not vjp_lambdas:
+            return
+        for scope in ast.walk(self.tree):
+            if not isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            idx_names = self._axis_index_names(scope)
+            if not idx_names:
+                continue
+            for nested in ast.walk(scope):
+                if nested is scope:
+                    continue
+                is_vjp = (
+                    isinstance(nested, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and nested.name in vjp_names
+                ) or (isinstance(nested, ast.Lambda) and id(nested) in vjp_lambdas)
+                if not is_vjp:
+                    continue
+                local = self._locally_bound(nested)
+                for n in ast.walk(nested):
+                    if (isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+                            and n.id in idx_names and n.id not in local):
+                        fname = getattr(nested, "name", "<lambda>")
+                        self.diags.append(D.make(
+                            "GLC007", "custom_vjp rule %r closes over %r, "
+                            "bound from jax.lax.axis_index in the enclosing "
+                            "scope: inside a shard_map region that index is "
+                            "a per-shard traced value and the legacy "
+                            "shard_map transpose replays the closure with "
+                            "the wrong shard's value; recompute "
+                            "jax.lax.axis_index inside the rule"
+                            % (fname, n.id),
+                            file=self.filename, line=n.lineno, key=n.id,
+                        ))
+                        break  # one finding per rule function
+
     # ---- pragmas -------------------------------------------------------
     def apply_pragmas(self) -> List[D.Diagnostic]:
         out = []
@@ -594,7 +702,8 @@ class _ModuleLint:
         return out
 
 
-ALL_RULES = frozenset({"GLC001", "GLC002", "GLC003", "GLC004", "GLC005", "GLC006"})
+ALL_RULES = frozenset(
+    {"GLC001", "GLC002", "GLC003", "GLC004", "GLC005", "GLC006", "GLC007"})
 
 # GLC006 scope: the runtime/observability library layers (posix or windows
 # separators); CLI drivers, analysis tools and tests are exempt by path
@@ -620,6 +729,7 @@ def lint_source(
     ml.check_donated_reuse()
     ml.check_host_syncs_in_loops()
     ml.check_runtime_logging()
+    ml.check_custom_vjp_closures()
     return ml.apply_pragmas()
 
 

@@ -73,6 +73,26 @@ CODES: Dict[str, Tuple[str, str]] = {
     "GLC004": (ERROR, "donated buffer used again after the donating jit call"),
     "GLC005": (WARNING, "blocking host sync inside a loop in driver code"),
     "GLC006": (WARNING, "ad-hoc print/append-file logging in runtime library code"),
+    "GLC007": (ERROR, "custom_vjp closes over a traced axis_index from an enclosing scope"),
+    # ---- traced-program linter (GLT0xx jaxpr-level hazards) ----
+    "GLT001": (ERROR, "reshape splits/merges an explicitly sharded dim inside a scan body"),
+    "GLT002": (ERROR, "sharded-dim reshape feeds a scan without a sharding constraint"),
+    "GLT003": (ERROR, "stacked init under out_shardings that shard the stacked dim"),
+    "GLT004": (WARNING, "donated input has no same-shape/dtype output to alias"),
+    "GLT005": (ERROR, "custom_vjp in a shard_map body closes over a dangling axis_index"),
+    "GLT006": (WARNING, "psum-of-psum over the same axis in a manual region (double count)"),
+    # ---- traced-program linter (GLT1xx collective audit) ----
+    "GLT101": (WARNING, "traced collectives contradict the cost model's predicted comm"),
+    "GLT102": (WARNING, "traced-program audit skipped or limited"),
+    # ---- jax-workaround inventory (WA0xx, utils/jax_compat.py registry) ----
+    "WA001": (WARNING, "shard_map modern-signature shim (axis_names/check_vma)"),
+    "WA002": (WARNING, "jax.sharding.get_abstract_mesh fallback shim"),
+    "WA003": (WARNING, "partial-manual shard_map compile gate (out-of-process probe)"),
+    "WA004": (WARNING, "jnp.stack (not concat+reshape) in stack_layer_run scan stacking"),
+    "WA005": (WARNING, "explicit sharding constraints on the pipeline microbatch split"),
+    "WA006": (WARNING, "host-side per-layer init + stack outside jit under pp shardings"),
+    "WA007": (WARNING, "persistent-cache bypass on XLA:CPU (deserialized-executable corruption)"),
+    "WA008": (WARNING, "no manual psum of tp cotangents (legacy shard_map auto-psum contract)"),
 }
 
 

@@ -15,8 +15,10 @@ Subcommands replace the reference's per-model shell scripts
     profile-hardware   profile ICI/DCN collective bandwidths
     lint               static analysis: validate strategy JSONs / scan code
                        for jax-API drift and jit hazards / audit checkpoint
-                       dirs offline (--ckpt: manifest integrity, provenance)
-                       (CPU only, no tracing; exits 1 on error diagnostics)
+                       dirs offline (--ckpt) / trace-lint the train step's
+                       jaxpr (--trace: GSPMD miscompile classes, collective
+                       audit) / jax-workaround inventory (--compat)
+                       (CPU only, never compiles; exits 1 on errors)
     report             analyze a telemetry JSONL written by `train
                        --telemetry`: steady-state step time, MFU, lifecycle
                        timeline, predicted-vs-measured divergence table

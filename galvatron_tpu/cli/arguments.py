@@ -205,6 +205,13 @@ def _add_train_args(p: argparse.ArgumentParser):
     r.add_argument("--emergency_save", type=int, default=1,
                    help="on SIGTERM/SIGINT, save a checkpoint at the next "
                         "step boundary (needs --save) and exit cleanly")
+    r.add_argument("--trace_lint", type=int, default=0,
+                   help="before compiling, abstract-eval the train step and "
+                        "run the traced-program linter (analysis/"
+                        "trace_lint.py, GLT codes): refuses on jaxpr-level "
+                        "hazards (pinned GSPMD miscompile shapes, dangling "
+                        "axis_index closures), prints warnings otherwise; "
+                        "adds one extra trace, no compile")
     r.add_argument("--anomaly_guard", type=int, default=1,
                    help="skip updates whose loss/grad norm is NaN/Inf (or "
                         "spikes past --loss_spike_factor) instead of "
@@ -441,6 +448,11 @@ def _add_search_args(p: argparse.ArgumentParser):
     g.add_argument("--serve_hbm_gbps", type=float, default=100.0,
                    help="per-chip HBM read bandwidth backing the decode "
                         "bandwidth roofline")
+    g.add_argument("--trace_lint", type=int, default=0,
+                   help="before save_results emits the winner, abstract-"
+                        "trace the train step it would jit and refuse on "
+                        "GLT errors (analysis/trace_lint.py); needs "
+                        "world_size visible devices, skipped otherwise")
 
 
 def _add_serve_args(p: argparse.ArgumentParser):

@@ -50,6 +50,7 @@ def search_args_from(args) -> SearchArgs:
         serve_max_concurrency=getattr(args, "serve_max_concurrency", 8),
         serve_page_size=getattr(args, "serve_page_size", 16),
         serve_hbm_gbps=getattr(args, "serve_hbm_gbps", 100.0),
+        trace_lint=bool(getattr(args, "trace_lint", 0)),
     )
 
 

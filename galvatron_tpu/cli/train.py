@@ -285,6 +285,21 @@ def _train(args) -> dict:
     model = fam.build(cfg, hp) if fam.build else construct_hybrid_parallel_model(cfg, hp)
     tx, _sched = get_optimizer_and_scheduler(optimizer_args_from(args))
 
+    # opt-in pre-trace hook (--trace_lint): walk the jaxpr of the exact step
+    # this driver is about to jit and refuse on GLT errors — the traced-
+    # program hazards (sharded-dim reshape under scan, stacked init under
+    # out_shardings, ...) that the source/strategy linters above cannot see
+    if getattr(args, "trace_lint", 0):
+        from galvatron_tpu.analysis import trace_lint as _tlint
+
+        _tres = _tlint.lint_hybrid_model(
+            model, data_kind=getattr(fam, "data_kind", "lm"), tx=tx)
+        if jax.process_index() == 0:
+            for _d in _tres.report.warnings:
+                print("trace lint: %s" % _d.format())
+        if not _tres.report.ok:
+            raise DiagnosticError(_tres.report.errors)
+
     # ------------------------------------------ silent-corruption sentinel
     # runtime/sdc.py: in-jit integrity digests ("digest"), per-replica vote
     # + freeze + drain-time repair/re-execute ("vote"), and the strike

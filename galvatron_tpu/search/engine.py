@@ -91,6 +91,11 @@ class SearchArgs:
     # decode (bandwidth-bound) separately over the decode-compatible subset
     # of the space and maximises decode tokens/s/chip under the p99 bounds
     objective: str = "train"  # train | serve
+    # opt-in winner validation (cli --trace_lint): before save_results emits
+    # the searched config, abstract-trace the train step it would jit and
+    # refuse on GLT errors (analysis/trace_lint.py) — needs world_size
+    # visible devices, silently skipped otherwise
+    trace_lint: bool = False
     p99_ttft_ms: float = 0.0  # p99 time-to-first-token bound, ms (0 = unbounded)
     p99_tpot_ms: float = 0.0  # p99 time-per-output-token bound, ms (0 = unbounded)
     serve_max_concurrency: int = 8  # decode slots the engine holds KV for
@@ -837,6 +842,50 @@ class GalvatronSearchEngine:
             ),
         )
 
+    def _trace_validate_winner(self, cfg) -> None:
+        """Opt-in (SearchArgs.trace_lint): abstract-trace the train step the
+        winner would jit — on a proxy transformer with the searched
+        hidden/seq dims — and refuse on GLT errors, so a searched config
+        that realizes into a hazardous traced program (pinned GSPMD
+        miscompile shapes) never gets emitted. Tracing needs `world_size`
+        visible devices to build the mesh; anything short of that (or a
+        family the proxy cannot stand in for) degrades to a logged skip —
+        the strategy lint above already guaranteed structural validity."""
+        _log = self.logger.info if self.logger else print
+        import jax
+
+        if len(jax.devices()) < self.world_size:
+            _log("trace lint skipped: %d device(s) visible < world_size %d"
+                 % (len(jax.devices()), self.world_size))
+            return
+        from galvatron_tpu.analysis import trace_lint as _tlint
+        from galvatron_tpu.models.gpt import gpt_config
+
+        lc = self.layer_configs[0]
+        hidden = int(lc.get("hidden_size", 64))
+        max_tp = max([s.tp for s in cfg.layers] + [1])
+        heads = next((h for h in (max_tp * 4, max_tp * 2, max_tp, 4, 2, 1)
+                      if h and hidden % h == 0 and h % max_tp == 0), None)
+        if heads is None:
+            _log("trace lint skipped: no head count divides hidden %d and "
+                 "tp %d" % (hidden, max_tp))
+            return
+        try:
+            mcfg = gpt_config(
+                "gpt-0.3b", hidden_size=hidden, num_heads=heads,
+                num_layers=cfg.num_layers,
+                max_seq_len=int(lc.get("seq_len", 64)), vocab_size=512)
+            res = _tlint.lint_model(mcfg, cfg)
+        except Exception as e:
+            _log("trace lint skipped: %s" % e)
+            return
+        for d in res.report.warnings:
+            _log("trace lint: %s" % d.format())
+        if not res.report.ok:
+            from galvatron_tpu.analysis.diagnostics import DiagnosticError
+
+            raise DiagnosticError(res.report.errors)
+
     def save_results(self, result: dict, path: Optional[str] = None) -> str:
         cfg = self.result_to_config(result)
         # lint the winner before emitting it: an emitted config must ALWAYS
@@ -854,6 +903,8 @@ class GalvatronSearchEngine:
             from galvatron_tpu.analysis.diagnostics import DiagnosticError
 
             raise DiagnosticError(report.errors)
+        if getattr(self.args, "trace_lint", False):
+            self._trace_validate_winner(cfg)
         path = path or os.path.join(
             self.config_dir,
             "galvatron_config_%s_%dgpus_%dGB_%s.json"

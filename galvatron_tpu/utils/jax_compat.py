@@ -23,7 +23,9 @@ lint clean exactly when this shim (or a modern jax) provides them.
 
 from __future__ import annotations
 
+from dataclasses import dataclass
 from functools import wraps
+from typing import Callable, List, Optional, Tuple
 
 import jax
 
@@ -54,6 +56,7 @@ def _shard_map_shim():
             check_rep=check_rep, auto=frozenset(auto), **kwargs,
         )
 
+    shard_map._galvatron_shim = True  # the WA001 inventory probe
     return shard_map
 
 
@@ -63,6 +66,7 @@ def _get_abstract_mesh_shim():
         callers fall back to their explicit concrete mesh."""
         return None
 
+    get_abstract_mesh._galvatron_shim = True  # the WA002 inventory probe
     return get_abstract_mesh
 
 
@@ -115,6 +119,223 @@ def supports_partial_manual_shard_map() -> bool:
     except Exception:  # noqa: BLE001 - any probe failure means "no"
         _PARTIAL_MANUAL["ok"] = False
     return _PARTIAL_MANUAL["ok"]
+
+
+# --------------------------------------------------------------------------
+# Workaround inventory (the `lint --compat` registry, ROADMAP item 5's
+# retirement checklist). Every pinned jax-0.4.37 workaround in the codebase
+# gets a stable WA*** id, an installed-jax probe and the pytest ids of the
+# tests that pin its behaviour, so the upgrade PR is mechanical: bump jax,
+# run `lint --compat --deep`, retire whatever reports RETIRABLE, and keep
+# whatever the pinning tests still demand. Probes return
+# ``(active, detail)`` where active is True (the installed jax still needs
+# the workaround), False (retirable) or None (cannot be decided cheaply —
+# rerun with deep=True or rerun the pinning tests on the new jax).
+
+
+@dataclass(frozen=True)
+class WorkaroundEntry:
+    code: str  # diagnostics.CODES id (WA0xx)
+    title: str
+    where: str  # the module carrying the workaround
+    pinning_tests: Tuple[str, ...]  # pytest ids that pin the behaviour
+    probe: Callable[[], Tuple[Optional[bool], str]]
+    deep_probe: Optional[Callable[[], Tuple[Optional[bool], str]]] = None
+
+
+def _jax_version_tuple() -> Tuple[int, ...]:
+    out = []
+    for part in jax.__version__.split("."):
+        digits = "".join(ch for ch in part if ch.isdigit())
+        if not digits:
+            break
+        out.append(int(digits))
+    return tuple(out)
+
+
+def _probe_shim(attr_chain: str):
+    def probe() -> Tuple[Optional[bool], str]:
+        obj = jax
+        for name in attr_chain.split("."):
+            obj = getattr(obj, name, None)
+            if obj is None:
+                return None, "%s missing from the installed jax" % attr_chain
+        if getattr(obj, "_galvatron_shim", False):
+            return True, "shim installed (jax %s lacks the native API)" % jax.__version__
+        return False, "jax %s provides %s natively — shim retirable" % (
+            jax.__version__, attr_chain)
+
+    return probe
+
+
+def _probe_miscompile_range(detail_active: str):
+    """The three GSPMD miscompile classes and the XLA:CPU cache corruption
+    are pinned on the 0.4.x line; no cheap in-process probe can prove a
+    newer jax fixed them, so outside that range the answer is 'unverified —
+    rerun the pinning tests' rather than a guess."""
+
+    def probe() -> Tuple[Optional[bool], str]:
+        v = _jax_version_tuple()
+        if v[:2] <= (0, 4):
+            return True, "jax %s is in the pinned 0.4.x hazard range: %s" % (
+                jax.__version__, detail_active)
+        return None, ("unverified on jax %s — rerun the pinning tests "
+                      "before retiring" % jax.__version__)
+
+    return probe
+
+
+def _probe_partial_manual_cheap() -> Tuple[Optional[bool], str]:
+    if "ok" in _PARTIAL_MANUAL:  # a deep run already paid for the answer
+        return _probe_partial_manual_deep()
+    v = _jax_version_tuple()
+    if v[:2] <= (0, 4):
+        return True, ("jax %s: legacy auto= lowering emits PartitionId ops "
+                      "SPMD partitioning rejects (fatal XLA CHECK); probe "
+                      "with --deep to compile the 4-device toy" % jax.__version__)
+    return None, "needs the out-of-process compile probe (run with --deep)"
+
+
+def _probe_partial_manual_deep() -> Tuple[Optional[bool], str]:
+    ok = supports_partial_manual_shard_map()
+    if ok:
+        return False, ("installed jax compiles the partial-manual toy — the "
+                       "compile gate is retirable")
+    return True, "partial-manual shard_map still fails to compile (probed)"
+
+
+WORKAROUNDS: Tuple[WorkaroundEntry, ...] = (
+    WorkaroundEntry(
+        code="WA001",
+        title="jax.shard_map modern-signature shim "
+              "(axis_names/check_vma -> legacy auto/check_rep)",
+        where="utils/jax_compat.py:_shard_map_shim",
+        pinning_tests=(
+            "tests/analysis/test_jax_compat.py::test_shim_installed_by_package_import",
+            "tests/analysis/test_jax_compat.py::test_shard_map_full_manual_runs",
+        ),
+        probe=_probe_shim("shard_map"),
+    ),
+    WorkaroundEntry(
+        code="WA002",
+        title="jax.sharding.get_abstract_mesh fallback (no thread-local "
+              "mesh context on 0.4.x)",
+        where="utils/jax_compat.py:_get_abstract_mesh_shim",
+        pinning_tests=(
+            "tests/analysis/test_jax_compat.py::test_get_abstract_mesh_contract",
+        ),
+        probe=_probe_shim("sharding.get_abstract_mesh"),
+    ),
+    WorkaroundEntry(
+        code="WA003",
+        title="partial-manual shard_map compile gate (out-of-process probe; "
+              "1F1B engines skip on unsupported jax)",
+        where="utils/jax_compat.py:supports_partial_manual_shard_map",
+        pinning_tests=(
+            "tests/analysis/test_jax_compat.py::test_partial_manual_probe_is_cached_and_boolean",
+            "tests/analysis/test_jax_compat.py::test_shard_map_axis_names_accepts_partial_manual_tracing",
+        ),
+        probe=_probe_partial_manual_cheap,
+        deep_probe=_probe_partial_manual_deep,
+    ),
+    WorkaroundEntry(
+        code="WA004",
+        title="jnp.stack (never concat+reshape) when stacking layer params "
+              "for the scan runs — GSPMD miscompiles a sharded-dim reshape "
+              "inside a scan",
+        where="models/base.py:stack_layer_run",
+        pinning_tests=(
+            "tests/models/test_tp_comm_mode.py::test_sharded_paths_match_unsharded_reference",
+            "tests/analysis/test_trace_lint.py::test_glt001_sharded_reshape_in_scan_flagged",
+        ),
+        probe=_probe_miscompile_range(
+            "sharded-dim reshape inside scan corrupts the stacked values"),
+    ),
+    WorkaroundEntry(
+        code="WA005",
+        title="explicit sharding constraints on the pipeline microbatch "
+              "split before the tick scan",
+        where="parallel/pipeline.py:make_pipelined_loss",
+        pinning_tests=(
+            "tests/parallel/test_pipeline.py::test_pipeline_matches_dp",
+            "tests/analysis/test_trace_lint.py::test_glt002_unconstrained_microbatch_split_flagged",
+        ),
+        probe=_probe_miscompile_range(
+            "unconstrained dp-sharded split under the tick scan miscompiles"),
+    ),
+    WorkaroundEntry(
+        code="WA006",
+        title="pp>1 init: per-layer init jitted, stages stacked OUTSIDE jit, "
+              "then device_put — never fused under pp out_shardings",
+        where="runtime/model_api.py:HybridParallelModel.init_params",
+        pinning_tests=(
+            "tests/parallel/test_pipeline.py::test_pipelined_bert_mlm_matches_single_stage",
+            "tests/analysis/test_trace_lint.py::test_glt003_stacked_init_under_out_shardings_flagged",
+        ),
+        probe=_probe_miscompile_range(
+            "fused stacked init under pp out_shardings yields wrong entries"),
+    ),
+    WorkaroundEntry(
+        code="WA007",
+        title="persistent compilation cache bypassed for the AOT step; "
+              "in-process executable memo instead (XLA:CPU deserialized "
+              "executables corrupt the allocator heap)",
+        where="cli/train.py:_compile_uncached/_STEP_EXECUTABLES",
+        pinning_tests=(
+            "tests/analysis/test_compat_inventory.py::test_wa007_compile_uncached_bypasses_persistent_cache",
+        ),
+        # no deep probe on purpose: the failure mode is heap corruption in
+        # the probing process (see tests/conftest.py KNOWN HAZARD)
+        probe=_probe_miscompile_range(
+            "deserialized XLA:CPU executables SIGSEGV on the AOT fast path"),
+    ),
+    WorkaroundEntry(
+        code="WA008",
+        title="manual-TP bwd never psums cotangents over the tp axes — the "
+              "legacy shard_map transpose auto-psums unmentioned manual "
+              "axes at the region boundary",
+        where="parallel/tp_shard_map.py (autodiff note)",
+        pinning_tests=(
+            "tests/models/test_tp_comm_mode.py::test_manual_path_matches_gspmd",
+        ),
+        probe=_probe_shim("shard_map"),
+    ),
+)
+
+
+def workaround_inventory(deep: bool = False) -> List[dict]:
+    """Probe every registered workaround against the installed jax.
+    Each row: ``{code, title, where, active, detail, pinning_tests}`` with
+    ``active`` True/False/None (see module comment). ``deep=True`` runs the
+    expensive probes (out-of-process compiles) where one exists."""
+    rows = []
+    for wa in WORKAROUNDS:
+        probe = wa.deep_probe if (deep and wa.deep_probe is not None) else wa.probe
+        try:
+            active, detail = probe()
+        except Exception as e:  # a broken probe must not take down the CLI
+            active, detail = None, "probe failed: %s" % e
+        rows.append({
+            "code": wa.code,
+            "title": wa.title,
+            "where": wa.where,
+            "active": active,
+            "detail": detail,
+            "pinning_tests": list(wa.pinning_tests),
+        })
+    return rows
+
+
+def render_inventory(rows: List[dict]) -> str:
+    """Fixed-width human rendering of `workaround_inventory` output."""
+    lines = ["jax workaround inventory (installed jax %s):" % jax.__version__]
+    for r in rows:
+        status = {True: "ACTIVE", False: "RETIRABLE", None: "UNKNOWN"}[r["active"]]
+        lines.append("  %s  %-9s %s" % (r["code"], status, r["title"]))
+        lines.append("         where: %s" % r["where"])
+        lines.append("         probe: %s" % r["detail"])
+        lines.append("         pinned by: %s" % ", ".join(r["pinning_tests"]))
+    return "\n".join(lines)
 
 
 install()

@@ -1,10 +1,14 @@
 #!/usr/bin/env bash
-# CI gate: both static analyzers over the shipped package and the shipped
-# strategy corpus, machine-readable output, non-zero exit on any error
-# diagnostic. Run from anywhere; ~10s on a laptop CPU.
+# CI gate: every static analyzer over the shipped package and the shipped
+# strategy corpus — source AST (GLC), strategy JSON (GLS), checkpoint audit,
+# traced-program lint (GLT: the tiny CPU gpt's train step abstract-traced
+# under every valid strategy fixture, no compiles) and the jax-workaround
+# inventory (WA: a retirable workaround surfaces as a warning here first).
+# Machine-readable output, non-zero exit on any error diagnostic. Run from
+# anywhere; well under a minute on a laptop CPU.
 #
 #   scripts/lint.sh              # human output
-#   scripts/lint.sh --json       # JSON report (schema: analysis/diagnostics)
+#   scripts/lint.sh --json       # one JSON report (schema: analysis/diagnostics)
 #
 # ALLOWLIST: accepted exceptions go here as extra --rules filters or
 # `# galv-lint: ignore[CODE]` pragmas at the offending line (grep for the
@@ -23,5 +27,8 @@ exec env JAX_PLATFORMS=cpu python -m galvatron_tpu.cli lint \
     --code \
     --world_size 8 \
     --ckpt tests/analysis/fixtures/ckpt_valid \
+    --trace --compat \
+    --model_type gpt --hidden_size 64 --num_heads 4 \
+    --seq_length 64 --vocab_size 128 \
     tests/analysis/fixtures/valid/*.json \
     "$@"

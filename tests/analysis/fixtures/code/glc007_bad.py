@@ -1,0 +1,22 @@
+import jax
+import jax.numpy as jnp
+
+
+def tp_region(block):
+    """Runs inside shard_map over the "tp" axis."""
+    idx = jax.lax.axis_index("tp")
+
+    @jax.custom_vjp
+    def ring_scale(v):
+        return v * 2.0
+
+    def ring_fwd(v):
+        return ring_scale(v), v
+
+    def ring_bwd(res, g):
+        # GLC007: `idx` is the enclosing scope's traced axis_index — the
+        # transpose replays this closure with the wrong shard's value
+        return (g * jnp.float32(idx),)
+
+    ring_scale.defvjp(ring_fwd, ring_bwd)
+    return ring_scale(block)

@@ -86,7 +86,54 @@ def test_serve_mode_flag(capsys):
 def test_explain_prints_code_table(capsys):
     assert run(["--explain"]) == 0
     out = capsys.readouterr().out
-    for code in ("GLS001", "GLS014", "GLS101", "GLC001", "GLC004"):
+    for code in ("GLS001", "GLS014", "GLS101", "GLC001", "GLC004",
+                 "GLC007", "GLT001", "GLT003", "GLT101", "WA001", "WA008"):
+        assert code in out
+
+
+def test_did_you_mean_covers_new_families():
+    from galvatron_tpu.analysis import diagnostics as D
+
+    assert "GLT001" in D.did_you_mean("GLT0001", D.CODES)
+    assert "WA004" in D.did_you_mean("WA04", D.CODES)
+
+
+def test_trace_flag_on_fixture(capsys, devices8):
+    """--trace over a shipped strategy: exits 0, GLT family in the report
+    path, audit table printed in human mode."""
+    assert run([fx("valid/uniform_dp8.json"), "--world_size", "8",
+                "--trace", "--model_type", "gpt", "--hidden_size", "64",
+                "--num_heads", "4", "--seq_length", "64",
+                "--vocab_size", "128"]) == 0
+    out = capsys.readouterr().out
+    assert "trace audit" in out and "traced collectives" in out
+
+
+def test_trace_and_compat_json_additive(capsys, devices8):
+    """--json stays ONE parseable document; --trace/--compat add keys
+    without touching the schema existing consumers read."""
+    assert run(["--trace", "--compat", "--json", "--world_size", "8",
+                "--model_type", "gpt", "--hidden_size", "64",
+                "--num_heads", "4", "--seq_length", "64",
+                "--vocab_size", "128"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    # the original schema is intact...
+    assert payload["version"] == 1
+    assert set(payload["summary"]) == {"errors", "warnings", "codes"}
+    assert payload["summary"]["errors"] == 0
+    # ...and the new families ride along additively
+    assert [r["code"] for r in payload["compat_inventory"]] == [
+        "WA001", "WA002", "WA003", "WA004", "WA005", "WA006", "WA007",
+        "WA008"]
+    assert all(r["pinning_tests"] for r in payload["compat_inventory"])
+    assert payload["trace_audit"][0]["target"].startswith("<uniform")
+
+
+def test_compat_human_output_lists_workarounds(capsys):
+    assert run(["--compat"]) == 0
+    out = capsys.readouterr().out
+    assert "jax workaround inventory" in out
+    for code in ("WA001", "WA007"):
         assert code in out
 
 
@@ -127,3 +174,32 @@ def test_train_driver_lints_before_tracing(devices8):
     with pytest.raises(DiagnosticError) as ei:
         train(args)
     assert any(d.code == "GLS007" for d in ei.value.diagnostics)
+
+
+def test_train_driver_trace_lint_hook_refuses_on_glt_error(devices8, monkeypatch):
+    """--trace_lint 1: a GLT error from the traced-program linter aborts the
+    driver after model construction but before any compile. The linter's
+    actual verdicts are pinned in test_trace_lint.py; here the result is
+    injected so the test never compiles."""
+    from galvatron_tpu.analysis import diagnostics as D
+    from galvatron_tpu.analysis import trace_lint as TL
+    from galvatron_tpu.analysis.diagnostics import DiagnosticError
+    from galvatron_tpu.cli.arguments import initialize_galvatron
+    from galvatron_tpu.cli.train import train
+
+    def fake_lint(model, **kw):
+        rep = D.DiagnosticReport()
+        rep.add(D.make("GLT001", "injected traced-program hazard"))
+        return TL.TraceLintResult(report=rep)
+
+    monkeypatch.setattr(TL, "lint_hybrid_model", fake_lint)
+    args = initialize_galvatron(mode="train", argv=[
+        "--model_type", "gpt", "--set_model_config_manually", "1",
+        "--hidden_size", "64", "--num_attention_heads", "4",
+        "--num_layers", "2", "--seq_length", "64", "--vocab_size", "128",
+        "--world_size", "8", "--global_train_batch_size", "8",
+        "--train_iters", "1", "--trace_lint", "1",
+    ])
+    with pytest.raises(DiagnosticError) as ei:
+        train(args)
+    assert any(d.code == "GLT001" for d in ei.value.diagnostics)

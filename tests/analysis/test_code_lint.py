@@ -19,7 +19,8 @@ def lint_fixture(name, **kw):
         return C.lint_source(fp.read(), filename=filename, **kw)
 
 
-RULES = ("GLC001", "GLC002", "GLC003", "GLC004", "GLC005", "GLC006")
+RULES = ("GLC001", "GLC002", "GLC003", "GLC004", "GLC005", "GLC006",
+         "GLC007")
 
 
 @pytest.mark.parametrize("code", RULES)
@@ -146,6 +147,18 @@ def test_glc006_is_path_scoped():
     assert C.lint_source(src, filename=path) == []
     assert {d.code for d in C.lint_source(
         src, filename="galvatron_tpu/obs/glc006_bad.py")} == {"GLC006"}
+
+
+def test_glc007_shipped_tp_ring_is_clean():
+    """parallel/tp_shard_map.py is the module GLC007 pins: its vjp rules
+    recompute axis_index locally instead of closing over the region's."""
+    import galvatron_tpu
+
+    path = os.path.join(os.path.dirname(galvatron_tpu.__file__),
+                        "parallel", "tp_shard_map.py")
+    with open(path, "r", encoding="utf-8") as fp:
+        ds = C.lint_source(fp.read(), filename=path, rules={"GLC007"})
+    assert ds == [], [d.format() for d in ds]
 
 
 def test_glc006_pragma_suppression():

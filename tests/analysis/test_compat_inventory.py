@@ -1,0 +1,100 @@
+"""Workaround inventory (utils/jax_compat.py WORKAROUNDS, WA codes).
+
+The inventory is the retirement checklist for ROADMAP item 5 (breaking the
+jax-0.4.37 ceiling), so it must not rot: every entry needs a registered
+diagnostic code, a live probe, and pinning tests that actually exist in the
+suite — the honesty gate below collects them with pytest itself.
+"""
+
+import os
+import subprocess
+import sys
+
+import jax
+
+from galvatron_tpu.analysis import diagnostics as D
+from galvatron_tpu.utils import jax_compat as JC
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
+
+
+# ------------------------------------------------------------ registry shape
+def test_every_entry_has_registered_code_and_probe():
+    assert JC.WORKAROUNDS, "inventory is empty"
+    codes = [w.code for w in JC.WORKAROUNDS]
+    assert len(codes) == len(set(codes)), "duplicate WA codes"
+    for w in JC.WORKAROUNDS:
+        assert w.code in D.CODES, "%s not in diagnostics.CODES" % w.code
+        assert w.code.startswith("WA")
+        assert w.title and w.where and w.pinning_tests
+        assert callable(w.probe)
+
+
+def test_inventory_probes_on_installed_jax():
+    rows = JC.workaround_inventory()
+    assert [r["code"] for r in rows] == [w.code for w in JC.WORKAROUNDS]
+    for r in rows:
+        assert r["active"] in (True, False, None), r
+        assert isinstance(r["detail"], str) and r["detail"], r
+        assert r["pinning_tests"], r
+    # on the pinned jax 0.4.37 every shim/hazard workaround is ACTIVE
+    if jax.__version__ == "0.4.37":
+        shim_rows = [r for r in rows if r["code"] in
+                     ("WA001", "WA002", "WA004", "WA005", "WA006", "WA007")]
+        assert all(r["active"] is True for r in shim_rows), shim_rows
+
+
+def test_render_inventory_lists_every_code():
+    out = JC.render_inventory(JC.workaround_inventory())
+    for w in JC.WORKAROUNDS:
+        assert w.code in out
+        assert w.pinning_tests[0].split("::")[-1] in out
+
+
+# ------------------------------------------------------------- honesty gate
+def test_every_pinning_test_exists():
+    """Every `file::name` a WA entry names must be collectable by pytest —
+    one --collect-only subprocess over the union of referenced files."""
+    refs = sorted({t for w in JC.WORKAROUNDS for t in w.pinning_tests})
+    files = sorted({t.split("::")[0] for t in refs})
+    for f in files:
+        assert os.path.exists(os.path.join(REPO, f)), "missing file %s" % f
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "--collect-only", "-q",
+         "-p", "no:cacheprovider", *files],
+        cwd=REPO, capture_output=True, text=True, timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    collected = proc.stdout
+    missing = [t for t in refs if t not in collected]
+    assert not missing, "inventory names tests pytest cannot collect: %s\n%s" % (
+        missing, proc.stdout[-2000:] + proc.stderr[-2000:])
+
+
+# --------------------------------------------------------------- WA007 pin
+def test_wa007_compile_uncached_bypasses_persistent_cache():
+    """cli/train.py compiles the AOT step with the persistent compilation
+    cache knocked out (and restored after), reusing executables only via
+    the in-process _STEP_EXECUTABLES memo — the jaxlib 0.4.37 XLA:CPU
+    deserialized-executable heap corruption never gets a chance to fire."""
+    from collections import OrderedDict
+
+    from galvatron_tpu.cli import train as T
+
+    assert isinstance(T._STEP_EXECUTABLES, OrderedDict)
+
+    seen = {}
+
+    class FakeLowered:
+        def compile(self):
+            seen["cache_dir"] = jax.config.jax_compilation_cache_dir
+            return "exe"
+
+    prev = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", "/tmp/fake-jit-cache")
+    try:
+        assert T._compile_uncached(FakeLowered()) == "exe"
+        assert seen["cache_dir"] is None  # cache bypassed during compile
+        assert jax.config.jax_compilation_cache_dir == "/tmp/fake-jit-cache"
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)

@@ -52,6 +52,25 @@ def test_shipped_strategy_corpus_is_clean():
         assert report.ok, "%s:\n%s" % (path, report.render())
 
 
+def test_package_traces_glt_clean(gpt_cfg, devices8):
+    """The shipped model/runtime code realizes into GLT-clean traced
+    programs: the traced-program linter finds none of the pinned GSPMD
+    miscompile shapes in the train step the package itself jits. One dp and
+    one pp+tp layout cover the scan-stacked layer runs, the microbatch
+    split and the init program (abstract tracing only — no compiles)."""
+    from galvatron_tpu.analysis import trace_lint as TL
+    from galvatron_tpu.config.strategy import HybridParallelConfig
+
+    for hp in (
+        HybridParallelConfig.uniform(8, gpt_cfg.num_layers),
+        HybridParallelConfig.uniform(8, gpt_cfg.num_layers, pp=2, tp=2,
+                                     chunks=2),
+    ):
+        res = TL.lint_model(gpt_cfg, hp, devices8)
+        errors = [d for d in res.report.errors if not _allowed(d)]
+        assert errors == [], "\n".join(d.format() for d in errors)
+
+
 def test_lint_sh_json_contract():
     """scripts/lint.sh is the CI entry point: exits 0 on the shipped tree
     and its --json output parses with zero errors."""
